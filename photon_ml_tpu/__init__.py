@@ -1,0 +1,27 @@
+"""photon-ml-tpu: a TPU-native generalized linear / mixed-effect (GLMix) modeling framework.
+
+A from-scratch JAX/XLA re-design of the capabilities of LinkedIn's Photon ML
+(reference: biyan-linkedin/photon-ml): GLM training (linear / logistic / Poisson
+regression, smoothed-hinge linear SVM) with batch convex solvers (L-BFGS,
+OWL-QN, TRON), and GAME/GLMix mixed-effect models trained by coordinate descent
+over residuals — fixed effects data-parallel over a TPU mesh via `jit` + sharded
+batches (the all-reduce the reference got from Spark `treeAggregate`), random
+effects as entity-sharded, `vmap`-batched local solves (the reference's
+per-entity fan-out, re-idiomized for the MXU).
+
+Layer map (mirrors SURVEY.md §1, re-architected TPU-first):
+
+  cli/        drivers (training, scoring, feature indexing, feature bags)
+  io/         Avro + LIBSVM IO, model serialization, index maps
+  estimators  GameEstimator / GameTransformer       (photon_ml_tpu.estimators)
+  game/       coordinate descent engine, datasets, coordinates
+  models/     GLM + GAME model classes
+  optimize/   pure-functional L-BFGS / OWL-QN / TRON, batched masked solvers
+  ops/        losses, fused value/grad/Hv aggregation kernels, normalization
+  parallel/   mesh / sharding helpers, collectives
+  evaluation/ AUC, AUPR, RMSE, losses, precision@k, grouped evaluators
+  tuning/     Sobol random search + Gaussian-process Bayesian auto-tuning
+  utils/      logging, timing, state trackers
+"""
+
+__version__ = "0.1.0"

@@ -1,0 +1,24 @@
+from .features import FeatureMatrix, LabeledBatch, batch_from_coo, batch_from_dense, pad_batch
+from .glm import GLMObjective, compute_variances
+from .losses import LOGISTIC, LOSSES, POISSON, SMOOTHED_HINGE, SQUARED, PointwiseLoss, get_loss
+from .normalization import NormalizationContext, build_normalization, identity_normalization
+
+__all__ = [
+    "FeatureMatrix",
+    "LabeledBatch",
+    "batch_from_coo",
+    "batch_from_dense",
+    "pad_batch",
+    "GLMObjective",
+    "compute_variances",
+    "PointwiseLoss",
+    "LOGISTIC",
+    "SQUARED",
+    "POISSON",
+    "SMOOTHED_HINGE",
+    "LOSSES",
+    "get_loss",
+    "NormalizationContext",
+    "build_normalization",
+    "identity_normalization",
+]

@@ -1,0 +1,209 @@
+"""Columnar batch containers for GLM training data.
+
+A sample's journey (SURVEY.md §7.1): raw row -> (sparse features, label,
+offset, weight). On TPU the batch is a struct-of-arrays in one of two layouts:
+
+- ``dense``: ``x[n, d]`` — margins are a single MXU matmul. Right layout for
+  small/medium d and for per-entity projected subspace blocks.
+- ``ELL (padded sparse)``: ``idx[n, k] i32`` + ``val[n, k] f32`` with per-row
+  padding (idx=0, val=0). Margins are a gather + row-sum; gradient
+  accumulation is a scatter-add (segment sum). Right layout for very wide,
+  very sparse feature spaces where densification is impossible.
+
+Zero-valued padding entries contribute nothing to margins or gradients, so no
+separate mask is needed; padded *rows* carry weight 0.
+
+This replaces the reference's per-datum axpy hot loop
+(ValueAndGradientAggregator.scala:137-161) with batched XLA ops.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+Array = jax.Array
+
+
+@jax.tree_util.register_dataclass
+@dataclasses.dataclass(frozen=True)
+class FeatureMatrix:
+    """A batch of feature vectors, dense ``[n, d]`` or padded-sparse (ELL).
+
+    Exactly one of ``dense`` or (``idx``, ``val``) is set. ``dim`` is the
+    feature-space dimension d (static so jitted shapes are known).
+    """
+
+    dim: int = dataclasses.field(metadata=dict(static=True))
+    dense: Optional[Array] = None
+    idx: Optional[Array] = None
+    val: Optional[Array] = None
+
+    def __post_init__(self):
+        if (self.dense is None) == (self.idx is None):
+            raise ValueError("exactly one of dense / (idx, val) must be provided")
+        if self.idx is not None and self.val is None:
+            raise ValueError("sparse layout requires both idx and val")
+
+    @property
+    def is_dense(self) -> bool:
+        return self.dense is not None
+
+    @property
+    def n_rows(self) -> int:
+        return self.dense.shape[0] if self.is_dense else self.idx.shape[0]
+
+    def matvec(self, w: Array) -> Array:
+        """x @ w -> [n]."""
+        if self.is_dense:
+            return self.dense @ w
+        return jnp.sum(self.val * jnp.take(w, self.idx, axis=0), axis=1)
+
+    def rmatvec(self, c: Array) -> Array:
+        """x^T @ c -> [d]: the gradient-accumulation kernel."""
+        if self.is_dense:
+            return self.dense.T @ c
+        contrib = c[:, None] * self.val
+        return jnp.zeros(self.dim, dtype=contrib.dtype).at[self.idx.reshape(-1)].add(
+            contrib.reshape(-1)
+        )
+
+    def sq_rmatvec(self, c: Array) -> Array:
+        """(x*x)^T @ c -> [d]: Hessian-diagonal accumulation."""
+        if self.is_dense:
+            return (self.dense * self.dense).T @ c
+        contrib = c[:, None] * self.val * self.val
+        return jnp.zeros(self.dim, dtype=contrib.dtype).at[self.idx.reshape(-1)].add(
+            contrib.reshape(-1)
+        )
+
+    def to_dense(self) -> Array:
+        if self.is_dense:
+            return self.dense
+        n = self.idx.shape[0]
+        out = jnp.zeros((n, self.dim), dtype=self.val.dtype)
+        rows = jnp.broadcast_to(jnp.arange(n)[:, None], self.idx.shape)
+        return out.at[rows.reshape(-1), self.idx.reshape(-1)].add(self.val.reshape(-1))
+
+    def slice_rows(self, start: int, size: int) -> "FeatureMatrix":
+        if self.is_dense:
+            return FeatureMatrix(dim=self.dim, dense=jax.lax.dynamic_slice_in_dim(self.dense, start, size))
+        return FeatureMatrix(
+            dim=self.dim,
+            idx=jax.lax.dynamic_slice_in_dim(self.idx, start, size),
+            val=jax.lax.dynamic_slice_in_dim(self.val, start, size),
+        )
+
+
+@jax.tree_util.register_dataclass
+@dataclasses.dataclass(frozen=True)
+class LabeledBatch:
+    """Batch equivalent of the reference's ``RDD[LabeledPoint]``
+    (photon-lib .../data/LabeledPoint.scala:30-86): label/features/offset/weight.
+
+    Padded rows carry ``weight == 0`` and are invisible to the objective.
+    """
+
+    features: FeatureMatrix
+    labels: Array
+    offsets: Array
+    weights: Array
+
+    @property
+    def n_rows(self) -> int:
+        return self.features.n_rows
+
+    @property
+    def dim(self) -> int:
+        return self.features.dim
+
+    def with_offsets(self, offsets: Array) -> "LabeledBatch":
+        return dataclasses.replace(self, offsets=offsets)
+
+    def margins(self, coef: Array) -> Array:
+        """features.coef + offset (LabeledPoint.computeMargin semantics)."""
+        return self.features.matvec(coef) + self.offsets
+
+
+def batch_from_dense(
+    x: np.ndarray,
+    y: np.ndarray,
+    offsets: Optional[np.ndarray] = None,
+    weights: Optional[np.ndarray] = None,
+    dtype=jnp.float32,
+) -> LabeledBatch:
+    n, d = x.shape
+    return LabeledBatch(
+        features=FeatureMatrix(dim=d, dense=jnp.asarray(x, dtype)),
+        labels=jnp.asarray(y, dtype),
+        offsets=jnp.zeros(n, dtype) if offsets is None else jnp.asarray(offsets, dtype),
+        weights=jnp.ones(n, dtype) if weights is None else jnp.asarray(weights, dtype),
+    )
+
+
+def batch_from_coo(
+    rows: np.ndarray,
+    cols: np.ndarray,
+    vals: np.ndarray,
+    y: np.ndarray,
+    dim: int,
+    offsets: Optional[np.ndarray] = None,
+    weights: Optional[np.ndarray] = None,
+    max_nnz: Optional[int] = None,
+    dtype=jnp.float32,
+) -> LabeledBatch:
+    """Build an ELL-layout batch from COO triplets (host-side, numpy)."""
+    n = len(y)
+    counts = np.bincount(rows, minlength=n)
+    k = int(max_nnz if max_nnz is not None else (counts.max() if n else 0))
+    k = max(k, 1)
+    idx = np.zeros((n, k), dtype=np.int32)
+    val = np.zeros((n, k), dtype=np.float64)
+    order = np.argsort(rows, kind="stable")
+    pos = np.zeros(n, dtype=np.int64)
+    for r, c, v in zip(rows[order], cols[order], vals[order]):
+        p = pos[r]
+        if p < k:
+            idx[r, p] = c
+            val[r, p] = v
+            pos[r] = p + 1
+    return LabeledBatch(
+        features=FeatureMatrix(dim=dim, idx=jnp.asarray(idx), val=jnp.asarray(val, dtype)),
+        labels=jnp.asarray(y, dtype),
+        offsets=jnp.zeros(n, dtype) if offsets is None else jnp.asarray(offsets, dtype),
+        weights=jnp.ones(n, dtype) if weights is None else jnp.asarray(weights, dtype),
+    )
+
+
+def pad_batch(batch: LabeledBatch, target_rows: int) -> LabeledBatch:
+    """Pad a batch with zero-weight rows up to ``target_rows`` (static shapes
+    for jit; also used to make row counts divisible by the device mesh)."""
+    n = batch.n_rows
+    if n == target_rows:
+        return batch
+    if n > target_rows:
+        raise ValueError(f"batch has {n} rows > target {target_rows}")
+    extra = target_rows - n
+    pad1 = lambda a: jnp.concatenate([a, jnp.zeros((extra,), a.dtype)])
+    f = batch.features
+    if f.is_dense:
+        feats = FeatureMatrix(
+            dim=f.dim,
+            dense=jnp.concatenate([f.dense, jnp.zeros((extra, f.dim), f.dense.dtype)]),
+        )
+    else:
+        feats = FeatureMatrix(
+            dim=f.dim,
+            idx=jnp.concatenate([f.idx, jnp.zeros((extra, f.idx.shape[1]), f.idx.dtype)]),
+            val=jnp.concatenate([f.val, jnp.zeros((extra, f.val.shape[1]), f.val.dtype)]),
+        )
+    return LabeledBatch(
+        features=feats,
+        labels=pad1(batch.labels),
+        offsets=pad1(batch.offsets),
+        weights=pad1(batch.weights),
+    )

@@ -1,0 +1,175 @@
+"""Fused GLM objective kernels: value, gradient, Hessian-vector / diagonal / matrix.
+
+This is the TPU re-design of the reference's aggregator quartet
+(ValueAndGradientAggregator / HessianVectorAggregator / HessianDiagonalAggregator /
+HessianMatrixAggregator, photon-lib .../function/glm/): the per-partition
+``seqOp`` hot loop becomes one batched XLA computation, and the Spark
+``treeAggregate`` all-reduce becomes the implicit collective XLA inserts when the
+batch is sharded over a device mesh (SURVEY.md §2.1 P1-P3). No explicit psum is
+needed: under ``jit`` with a batch sharded on the "data" mesh axis and
+replicated coefficients, the ``jnp.sum``/``rmatvec`` reductions lower to
+all-reduces over ICI.
+
+Objective (sum, not mean — parity with the reference):
+
+    F(w') = sum_i weight_i * l(margin_i, y_i) + (l2/2) * ||w'||^2
+    margin_i = effective_coef . x_i + margin_shift + offset_i
+
+with effective_coef = w' .* factor, margin_shift = -effective_coef.shift from
+the NormalizationContext (normalized features are never materialized;
+derivation at ValueAndGradientAggregator.scala:36-80).
+
+L1 is NOT part of the objective — it lives in the OWL-QN solver
+(reference: DistributedOptimizationProblem.scala:64-75).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from .features import LabeledBatch
+from .losses import PointwiseLoss
+from .normalization import NormalizationContext, identity_normalization
+
+Array = jax.Array
+
+
+@jax.tree_util.register_dataclass
+@dataclasses.dataclass(frozen=True)
+class GLMObjective:
+    """A pure-functional GLM objective over a fixed batch.
+
+    The same object serves both of the reference's execution modes
+    (DistributedObjectiveFunction / SingleNodeObjectiveFunction,
+    photon-api .../function/): "distributed" is just this objective jitted
+    with a device-sharded batch; "local" is the same code vmapped over
+    per-entity blocks. The reference achieved this with abstract
+    ``type Data`` polymorphism (ObjectiveFunction.scala:25-74); here it falls
+    out of JAX's transforms.
+    """
+
+    loss: PointwiseLoss
+    batch: LabeledBatch
+    l2: float = dataclasses.field(default=0.0, metadata=dict(static=True))
+    norm: Optional[NormalizationContext] = None
+
+    def _norm(self) -> NormalizationContext:
+        return self.norm if self.norm is not None else identity_normalization()
+
+    def _margins(self, coef: Array) -> Tuple[Array, Array]:
+        """Returns (margins, effective_coef)."""
+        eff, mshift = self._norm().effective_coefficients(coef)
+        return self.batch.features.matvec(eff) + mshift + self.batch.offsets, eff
+
+    def value(self, coef: Array) -> Array:
+        return self.value_and_grad(coef)[0]
+
+    def gradient(self, coef: Array) -> Array:
+        return self.value_and_grad(coef)[1]
+
+    def value_and_grad(self, coef: Array) -> Tuple[Array, Array]:
+        b = self.batch
+        norm = self._norm()
+        z, _ = self._margins(coef)
+        loss, dz = self.loss.loss_and_dz(z, b.labels)
+        wdz = b.weights * dz
+        value = jnp.sum(b.weights * loss)
+        raw_grad = b.features.rmatvec(wdz)
+        # grad_j = factor_j * (raw_grad_j - shift_j * sum_i w_i dz_i)
+        grad = raw_grad
+        if norm.shifts is not None:
+            grad = grad - norm.shifts * jnp.sum(wdz)
+        if norm.factors is not None:
+            grad = grad * norm.factors
+        if self.l2 > 0.0:
+            value = value + 0.5 * self.l2 * jnp.dot(coef, coef)
+            grad = grad + self.l2 * coef
+        return value, grad
+
+    def _d2z_weights(self, coef: Array) -> Array:
+        b = self.batch
+        z, _ = self._margins(coef)
+        return b.weights * self.loss.d2z(z, b.labels)
+
+    def hessian_vector(self, coef: Array, v: Array) -> Array:
+        """H(w') v — the TRON inner-CG kernel
+        (reference: HessianVectorAggregator.scala:38-173).
+
+        hv_j = factor_j * (sum_i x_ji * w_i l''_i u_i - shift_j * sum_i w_i l''_i u_i)
+        with u_i = (x_i - shift) .* factor . v  (a margin of v with zero offset).
+        """
+        b = self.batch
+        norm = self._norm()
+        wl2 = self._d2z_weights(coef)
+        eff_v, vshift = norm.effective_coefficients(v)
+        u = b.features.matvec(eff_v) + vshift
+        c = wl2 * u
+        hv = b.features.rmatvec(c)
+        if norm.shifts is not None:
+            hv = hv - norm.shifts * jnp.sum(c)
+        if norm.factors is not None:
+            hv = hv * norm.factors
+        if self.l2 > 0.0:
+            hv = hv + self.l2 * v
+        return hv
+
+    def hessian_diagonal(self, coef: Array) -> Array:
+        """diag H = sum_i w_i l''_i x'_ji^2 (+ l2), expanded for normalization:
+        f_j^2 [S2_j - 2 s_j S1_j + s_j^2 S0] with S2=sum c x^2, S1=sum c x, S0=sum c.
+        (reference: HessianDiagonalAggregator.scala:33-128; used for SIMPLE
+        variance = 1/diag, DistributedOptimizationProblem.scala:84-108)."""
+        b = self.batch
+        norm = self._norm()
+        c = self._d2z_weights(coef)
+        s2 = b.features.sq_rmatvec(c)
+        diag = s2
+        if norm.shifts is not None:
+            s1 = b.features.rmatvec(c)
+            s0 = jnp.sum(c)
+            diag = s2 - 2.0 * norm.shifts * s1 + norm.shifts**2 * s0
+        if norm.factors is not None:
+            diag = diag * norm.factors**2
+        if self.l2 > 0.0:
+            diag = diag + self.l2
+        return diag
+
+    def hessian_matrix(self, coef: Array) -> Array:
+        """Dense d x d Hessian = X'^T diag(w l'') X' (+ l2 I). Used for FULL
+        variance (diag of inverse); densifies features, so only for small d
+        (reference: HessianMatrixAggregator.scala:33-129)."""
+        b = self.batch
+        norm = self._norm()
+        c = self._d2z_weights(coef)
+        x = b.features.to_dense()
+        if norm.shifts is not None:
+            x = x - norm.shifts[None, :]
+        if norm.factors is not None:
+            x = x * norm.factors[None, :]
+        h = x.T @ (c[:, None] * x)
+        if self.l2 > 0.0:
+            h = h + self.l2 * jnp.eye(h.shape[0], dtype=h.dtype)
+        return h
+
+
+def compute_variances(
+    objective: GLMObjective, coef: Array, variance_type: str
+) -> Optional[Array]:
+    """Coefficient variances (reference: DistributedOptimizationProblem.computeVariances,
+    photon-api .../optimization/DistributedOptimizationProblem.scala:84-108).
+
+    SIMPLE -> 1 / diag(H); FULL -> diag(H^-1) via Cholesky; NONE -> None.
+    """
+    vt = variance_type.upper()
+    if vt == "NONE":
+        return None
+    if vt == "SIMPLE":
+        d = objective.hessian_diagonal(coef)
+        return 1.0 / jnp.where(d == 0, 1.0, d)
+    if vt == "FULL":
+        h = objective.hessian_matrix(coef)
+        return jnp.diag(jnp.linalg.inv(h))
+    raise ValueError(f"Unknown variance computation type: {variance_type!r}")

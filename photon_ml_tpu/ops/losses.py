@@ -1,0 +1,144 @@
+"""Pointwise GLM loss functions.
+
+Each loss is the per-sample negative log-likelihood l(z, y) of a GLM as a
+function of the margin z = x.w + offset and the label y, together with its
+first and second derivatives in z. The trio (l, dl/dz, d2l/dz2) is everything
+the fused value/gradient/Hessian kernels in `photon_ml_tpu.ops.glm` need.
+
+Parity contract (reference: photon-lib .../function/glm/PointwiseLossFunction.scala:36-54
+and the concrete losses in photon-api .../function/glm/ + .../function/svm/):
+
+  logistic:       l = log(1 + exp(-y'z)) with y' in {-1, +1}  (labels > 0.5 are positive)
+  squared:        l = (z - y)^2 / 2
+  poisson:        l = exp(z) - y * z
+  smoothed_hinge: Rennie's smoothed hinge on y' * z (y' in {-1, +1})
+
+All functions are elementwise, dtype-preserving, and safe under jit/vmap/grad.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Callable, Tuple
+
+import jax
+import jax.numpy as jnp
+
+Array = jax.Array
+
+# Labels strictly greater than this are "positive" for binary losses
+# (reference: MathConst.POSITIVE_RESPONSE_THRESHOLD = 0.5).
+POSITIVE_RESPONSE_THRESHOLD = 0.5
+
+
+def _log1pexp(x: Array) -> Array:
+    """Numerically stable log(1 + exp(x)) (= softplus)."""
+    return jnp.logaddexp(x, 0.0)
+
+
+def _sigmoid(x: Array) -> Array:
+    return jax.nn.sigmoid(x)
+
+
+@jax.tree_util.register_static
+@dataclasses.dataclass(frozen=True)
+class PointwiseLoss:
+    """A pointwise loss l(z, y) with derivatives in the margin z.
+
+    Static pytree node: closes over pure elementwise functions, so objects of
+    this class can be captured in jitted closures and compared by identity.
+    """
+
+    name: str
+    loss_and_dz: Callable[[Array, Array], Tuple[Array, Array]]
+    d2z: Callable[[Array, Array], Array]
+
+    def loss(self, z: Array, y: Array) -> Array:
+        return self.loss_and_dz(z, y)[0]
+
+
+def _logistic_loss_and_dz(z: Array, y: Array) -> Tuple[Array, Array]:
+    # Positive sample: l = log1pexp(-z), dl/dz = -sigmoid(-z)
+    # Negative sample: l = log1pexp(z),  dl/dz =  sigmoid(z)
+    pos = y > POSITIVE_RESPONSE_THRESHOLD
+    sz = jnp.where(pos, -z, z)
+    loss = _log1pexp(sz)
+    dz = jnp.where(pos, -_sigmoid(-z), _sigmoid(z))
+    return loss, dz
+
+
+def _logistic_d2z(z: Array, y: Array) -> Array:
+    s = _sigmoid(z)
+    return s * (1.0 - s)
+
+
+def _squared_loss_and_dz(z: Array, y: Array) -> Tuple[Array, Array]:
+    diff = z - y
+    return 0.5 * diff * diff, diff
+
+
+def _squared_d2z(z: Array, y: Array) -> Array:
+    return jnp.ones_like(z)
+
+
+def _poisson_loss_and_dz(z: Array, y: Array) -> Tuple[Array, Array]:
+    ez = jnp.exp(z)
+    return ez - y * z, ez - y
+
+
+def _poisson_d2z(z: Array, y: Array) -> Array:
+    return jnp.exp(z)
+
+
+def _smoothed_hinge_loss_and_dz(z: Array, y: Array) -> Tuple[Array, Array]:
+    # Rennie's smoothed hinge on m = y' * z with y' in {-1, +1}:
+    #   l(m) = 0.5 - m        if m <= 0
+    #          0.5 (1 - m)^2  if 0 < m < 1
+    #          0              if m >= 1
+    # (reference: photon-api .../function/svm/SmoothedHingeLossFunction.scala:34-67)
+    ymod = jnp.where(y > POSITIVE_RESPONSE_THRESHOLD, 1.0, -1.0).astype(z.dtype)
+    m = ymod * z
+    loss = jnp.where(m <= 0.0, 0.5 - m, jnp.where(m < 1.0, 0.5 * (1.0 - m) ** 2, 0.0))
+    dm = jnp.where(m < 0.0, -1.0, jnp.where(m < 1.0, m - 1.0, 0.0))
+    return loss, dm * ymod
+
+
+def _smoothed_hinge_d2z(z: Array, y: Array) -> Array:
+    # Second derivative is 1 on the quadratic segment, 0 elsewhere; the
+    # reference's SVM path never uses it (only first-order solvers), but it is
+    # well-defined and lets TRON run on this loss too.
+    ymod = jnp.where(y > POSITIVE_RESPONSE_THRESHOLD, 1.0, -1.0).astype(z.dtype)
+    m = ymod * z
+    return jnp.where((m > 0.0) & (m < 1.0), 1.0, 0.0).astype(z.dtype)
+
+
+LOGISTIC = PointwiseLoss("logistic", _logistic_loss_and_dz, _logistic_d2z)
+SQUARED = PointwiseLoss("squared", _squared_loss_and_dz, _squared_d2z)
+POISSON = PointwiseLoss("poisson", _poisson_loss_and_dz, _poisson_d2z)
+SMOOTHED_HINGE = PointwiseLoss(
+    "smoothed_hinge", _smoothed_hinge_loss_and_dz, _smoothed_hinge_d2z
+)
+
+LOSSES = {
+    "logistic": LOGISTIC,
+    "squared": SQUARED,
+    "poisson": POISSON,
+    "smoothed_hinge": SMOOTHED_HINGE,
+}
+
+# Task-type -> loss dispatch (reference: ObjectiveFunctionHelper.scala:28-47).
+TASK_LOSSES = {
+    "logistic_regression": LOGISTIC,
+    "linear_regression": SQUARED,
+    "poisson_regression": POISSON,
+    "smoothed_hinge_loss_linear_svm": SMOOTHED_HINGE,
+}
+
+
+def get_loss(name: str) -> PointwiseLoss:
+    key = name.lower()
+    if key in LOSSES:
+        return LOSSES[key]
+    if key in TASK_LOSSES:
+        return TASK_LOSSES[key]
+    raise KeyError(f"Unknown loss or task type: {name!r}")

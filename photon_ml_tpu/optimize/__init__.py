@@ -1,0 +1,23 @@
+from .common import (
+    ConvergenceReason,
+    OptimizerConfig,
+    OptimizerType,
+    SolverResult,
+    abs_tolerances,
+    project_box,
+)
+from .lbfgs import solve_lbfgs
+from .tron import solve_tron
+from .driver import optimize
+
+__all__ = [
+    "ConvergenceReason",
+    "OptimizerConfig",
+    "OptimizerType",
+    "SolverResult",
+    "abs_tolerances",
+    "project_box",
+    "solve_lbfgs",
+    "solve_tron",
+    "optimize",
+]

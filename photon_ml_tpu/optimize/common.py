@@ -1,0 +1,145 @@
+"""Shared optimizer contracts: convergence reasons, configs, results.
+
+TPU re-design of the reference's Optimizer base
+(photon-lib .../optimization/Optimizer.scala:35-238): instead of a mutable
+iterate-until-converged driver object, each solver is a pure function running
+its whole loop inside ``lax.while_loop`` with *masked* state updates — the
+same compiled code therefore serves the reference's two execution modes:
+
+- scalar: one (possibly device-sharded) problem — the fixed-effect solve;
+- vmapped: thousands of per-entity problems advancing in lockstep with
+  per-lane ``done`` freezing — the random-effect solve (SURVEY.md §7.3).
+
+Convergence semantics are parity-matched to Optimizer.scala:126-139:
+tolerances are *relative*, converted to absolute using the state at zero
+coefficients (loss(0) * tol, ||grad(0)|| * tol; Optimizer.scala:65-69,171),
+and the reasons are checked in the reference's order.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import enum
+from typing import Callable, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+Array = jax.Array
+
+# Callable w -> (value, gradient)
+ValueAndGradFn = Callable[[Array], Tuple[Array, Array]]
+# Callable (w, v) -> H(w) v
+HvpFn = Callable[[Array, Array], Array]
+
+
+class ConvergenceReason(enum.IntEnum):
+    """Reference: photon-lib .../optimization/ConvergenceReason.scala."""
+
+    NOT_CONVERGED = 0
+    MAX_ITERATIONS = 1
+    OBJECTIVE_NOT_IMPROVING = 2
+    FUNCTION_VALUES_CONVERGED = 3
+    GRADIENT_CONVERGED = 4
+
+
+class OptimizerType(str, enum.Enum):
+    LBFGS = "LBFGS"
+    OWLQN = "OWLQN"
+    LBFGSB = "LBFGSB"
+    TRON = "TRON"
+
+
+@dataclasses.dataclass(frozen=True)
+class OptimizerConfig:
+    """Mirrors the reference's OptimizerConfig + regularization plumbing.
+
+    Defaults are the reference's (LBFGS.scala:149-154, TRON.scala:252-258).
+    ``l1_weight`` routes LBFGS -> OWL-QN (reference: OptimizerFactory.scala:30-74).
+    ``box_constraints`` = (lower[d], upper[d]) applied by projection after each
+    accepted step (reference: OptimizationUtils.projectCoefficientsToSubspace).
+    """
+
+    optimizer_type: OptimizerType = OptimizerType.LBFGS
+    tolerance: float = 1e-7
+    max_iterations: int = 100
+    num_corrections: int = 10
+    l1_weight: float = 0.0
+    box_constraints: Optional[Tuple[Array, Array]] = None
+    max_line_search_iterations: int = 25
+    # TRON-specific
+    max_improvement_failures: int = 5
+    max_cg_iterations: int = 20
+
+    def normalized_type(self) -> OptimizerType:
+        t = OptimizerType(self.optimizer_type)
+        if t == OptimizerType.LBFGS and self.l1_weight > 0.0:
+            return OptimizerType.OWLQN
+        return t
+
+
+@jax.tree_util.register_dataclass
+@dataclasses.dataclass(frozen=True)
+class SolverResult:
+    """Final solver state plus fixed-size per-iteration history
+    (the functional OptimizationStatesTracker, Optimizer.scala /
+    OptimizationStatesTracker.scala:32-121)."""
+
+    coefficients: Array
+    loss: Array
+    gradient: Array
+    iterations: Array  # i32 scalar
+    reason: Array  # i32 scalar, ConvergenceReason code
+    loss_history: Array  # f[max_iter + 1], NaN-padded
+    grad_norm_history: Array  # f[max_iter + 1], NaN-padded
+
+    @property
+    def converged(self) -> Array:
+        return self.reason != ConvergenceReason.NOT_CONVERGED
+
+
+def project_box(w: Array, box: Optional[Tuple[Array, Array]]) -> Array:
+    """Clamp coefficients into [lower, upper] (OptimizationUtils.scala:34-66)."""
+    if box is None:
+        return w
+    lower, upper = box
+    return jnp.clip(w, lower, upper)
+
+
+def check_convergence(
+    it: Array,
+    max_iterations: int,
+    loss: Array,
+    prev_loss: Array,
+    grad_norm: Array,
+    loss_abs_tol: Array,
+    grad_abs_tol: Array,
+    objective_not_improving: Array,
+) -> Array:
+    """Reason code in the reference's precedence order (Optimizer.scala:126-139)."""
+    reason = jnp.where(
+        grad_norm <= grad_abs_tol, ConvergenceReason.GRADIENT_CONVERGED, 0
+    )
+    reason = jnp.where(
+        jnp.abs(loss - prev_loss) <= loss_abs_tol,
+        ConvergenceReason.FUNCTION_VALUES_CONVERGED,
+        reason,
+    )
+    reason = jnp.where(
+        objective_not_improving, ConvergenceReason.OBJECTIVE_NOT_IMPROVING, reason
+    )
+    reason = jnp.where(it >= max_iterations, ConvergenceReason.MAX_ITERATIONS, reason)
+    return reason.astype(jnp.int32)
+
+
+def abs_tolerances(
+    value_and_grad: ValueAndGradFn, zero_like: Array, tolerance: float
+) -> Tuple[Array, Array]:
+    """Absolute tolerances from the state at zero coefficients
+    (Optimizer.scala:65-69 + :171)."""
+    f0, g0 = value_and_grad(jnp.zeros_like(zero_like))
+    return jnp.abs(f0) * tolerance, _norm(g0) * tolerance
+
+
+def _norm(v: Array) -> Array:
+    return jnp.sqrt(jnp.sum(v * v))

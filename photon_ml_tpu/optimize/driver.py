@@ -1,0 +1,72 @@
+"""Optimizer dispatch: config -> solver run.
+
+The functional analogue of the reference's OptimizerFactory + Optimizer.optimize
+(photon-api .../optimization/OptimizerFactory.scala:30-74,
+photon-lib .../optimization/Optimizer.scala:161-185): computes the relative ->
+absolute tolerance conversion from the zero state, dispatches on optimizer
+type (LBFGS / OWLQN / LBFGSB / TRON), and runs the whole solve on device.
+
+``value_and_grad`` (and ``hvp`` for TRON) close over their data; whether that
+data is a device-sharded global batch (fixed effect) or one lane of a vmapped
+per-entity block (random effect) is invisible here — the reference needed a
+Distributed/SingleNode class pair for this (SURVEY.md §2.2), we need one
+function.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax.numpy as jnp
+
+from .common import (
+    HvpFn,
+    OptimizerConfig,
+    OptimizerType,
+    SolverResult,
+    ValueAndGradFn,
+    abs_tolerances,
+)
+from .lbfgs import solve_lbfgs
+from .tron import solve_tron
+
+Array = jnp.ndarray
+
+
+def optimize(
+    value_and_grad: ValueAndGradFn,
+    w0: Array,
+    config: OptimizerConfig,
+    hvp: Optional[HvpFn] = None,
+) -> SolverResult:
+    loss_tol, grad_tol = abs_tolerances(value_and_grad, w0, config.tolerance)
+    kind = config.normalized_type()
+
+    if kind in (OptimizerType.LBFGS, OptimizerType.LBFGSB, OptimizerType.OWLQN):
+        box = config.box_constraints
+        return solve_lbfgs(
+            value_and_grad,
+            w0,
+            loss_tol,
+            grad_tol,
+            max_iterations=config.max_iterations,
+            num_corrections=config.num_corrections,
+            l1_weight=config.l1_weight if kind == OptimizerType.OWLQN else 0.0,
+            box_constraints=box,
+            max_line_search_iterations=config.max_line_search_iterations,
+        )
+    if kind == OptimizerType.TRON:
+        if hvp is None:
+            raise ValueError("TRON requires a Hessian-vector-product function")
+        return solve_tron(
+            value_and_grad,
+            hvp,
+            w0,
+            loss_tol,
+            grad_tol,
+            max_iterations=config.max_iterations,
+            max_cg_iterations=config.max_cg_iterations,
+            max_improvement_failures=config.max_improvement_failures,
+            box_constraints=config.box_constraints,
+        )
+    raise ValueError(f"Unknown optimizer type: {config.optimizer_type!r}")

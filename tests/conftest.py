@@ -1,0 +1,36 @@
+"""Test harness configuration.
+
+Mirrors the reference's SparkTestUtils strategy (photon-test-utils
+.../SparkTestUtils.scala:58-76): "distributed" behavior is tested without a
+cluster by running the real collective code paths on local devices. Here the
+local cluster is a virtual 8-device CPU mesh
+(``--xla_force_host_platform_device_count=8``), and float64 is enabled so math
+tests can compare against scipy at tight tolerances.
+
+These env vars MUST be set before jax is imported anywhere.
+"""
+
+import os
+
+# Force CPU: the surrounding environment may point JAX at a real accelerator
+# (e.g. JAX_PLATFORMS=axon, which also ignores later env-var edits — the
+# config update below is what actually pins the platform).
+os.environ["JAX_PLATFORMS"] = "cpu"
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_enable_x64", True)
+
+import numpy as np  # noqa: E402
+import pytest  # noqa: E402
+
+
+@pytest.fixture
+def rng():
+    return np.random.default_rng(0)

@@ -1,0 +1,218 @@
+"""GLM objective kernel tests: fused value/grad/Hv/Hdiag/Hmat vs jax autodiff
+and an independent dense numpy implementation, dense vs ELL-sparse parity,
+normalization algebra, and weighted/offset semantics.
+
+Mirrors the reference's aggregator + OptimizationProblemIntegTestUtils
+cross-check strategy (SURVEY.md §4)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from photon_ml_tpu.ops import (
+    GLMObjective,
+    LOGISTIC,
+    POISSON,
+    SQUARED,
+    batch_from_coo,
+    batch_from_dense,
+    build_normalization,
+    compute_variances,
+)
+from photon_ml_tpu.ops import losses as L
+
+
+def make_problem(rng, n=64, d=7, loss=LOGISTIC):
+    x = rng.normal(size=(n, d))
+    w_true = rng.normal(size=d)
+    logits = x @ w_true
+    if loss is LOGISTIC:
+        y = (rng.uniform(size=n) < 1 / (1 + np.exp(-logits))).astype(float)
+    elif loss is POISSON:
+        y = rng.poisson(np.exp(np.clip(logits, -3, 3))).astype(float)
+    else:
+        y = logits + rng.normal(size=n)
+    offs = rng.normal(size=n) * 0.1
+    wts = rng.uniform(0.5, 2.0, size=n)
+    return x, y, offs, wts
+
+
+def reference_value_grad(loss_name, x, y, offs, wts, w, l2=0.0):
+    """Independent numpy implementation."""
+    z = x @ w + offs
+    if loss_name == "logistic":
+        val = np.sum(wts * (np.log1p(np.exp(-np.abs(z))) + np.maximum(z, 0) - y * z))
+        dz = 1 / (1 + np.exp(-z)) - y
+    elif loss_name == "squared":
+        val = np.sum(wts * 0.5 * (z - y) ** 2)
+        dz = z - y
+    elif loss_name == "poisson":
+        val = np.sum(wts * (np.exp(z) - y * z))
+        dz = np.exp(z) - y
+    grad = x.T @ (wts * dz)
+    return val + 0.5 * l2 * w @ w, grad + l2 * w
+
+
+@pytest.mark.parametrize("loss", [LOGISTIC, SQUARED, POISSON])
+def test_value_and_grad_vs_numpy(rng, loss):
+    x, y, offs, wts = make_problem(rng, loss=loss)
+    w = rng.normal(size=x.shape[1]) * 0.3
+    batch = batch_from_dense(x, y, offs, wts, dtype=jnp.float64)
+    obj = GLMObjective(loss=loss, batch=batch, l2=0.7)
+    v, g = obj.value_and_grad(jnp.asarray(w))
+    rv, rg = reference_value_grad(loss.name, x, y, offs, wts, w, l2=0.7)
+    np.testing.assert_allclose(float(v), rv, rtol=1e-10)
+    np.testing.assert_allclose(np.asarray(g), rg, rtol=1e-9)
+
+
+def test_grad_matches_autodiff(rng):
+    x, y, offs, wts = make_problem(rng)
+    batch = batch_from_dense(x, y, offs, wts, dtype=jnp.float64)
+    obj = GLMObjective(loss=LOGISTIC, batch=batch, l2=0.3)
+    w = jnp.asarray(rng.normal(size=x.shape[1]))
+    auto = jax.grad(obj.value)(w)
+    _, fused = obj.value_and_grad(w)
+    np.testing.assert_allclose(np.asarray(fused), np.asarray(auto), rtol=1e-10)
+
+
+def test_sparse_dense_parity(rng):
+    n, d = 40, 12
+    dense = rng.normal(size=(n, d)) * (rng.uniform(size=(n, d)) < 0.3)
+    y = (rng.uniform(size=n) < 0.5).astype(float)
+    rows, cols = np.nonzero(dense)
+    vals = dense[rows, cols]
+    b_dense = batch_from_dense(dense, y, dtype=jnp.float64)
+    b_sparse = batch_from_coo(rows, cols, vals, y, dim=d, dtype=jnp.float64)
+    w = jnp.asarray(rng.normal(size=d))
+    for loss in (LOGISTIC, SQUARED):
+        od, os_ = (GLMObjective(loss=loss, batch=b) for b in (b_dense, b_sparse))
+        vd, gd = od.value_and_grad(w)
+        vs, gs = os_.value_and_grad(w)
+        np.testing.assert_allclose(float(vd), float(vs), rtol=1e-10)
+        np.testing.assert_allclose(np.asarray(gd), np.asarray(gs), rtol=1e-9)
+        v = jnp.asarray(rng.normal(size=d))
+        np.testing.assert_allclose(
+            np.asarray(od.hessian_vector(w, v)),
+            np.asarray(os_.hessian_vector(w, v)),
+            rtol=1e-9,
+        )
+        np.testing.assert_allclose(
+            np.asarray(od.hessian_diagonal(w)),
+            np.asarray(os_.hessian_diagonal(w)),
+            rtol=1e-9,
+        )
+
+
+def test_hessian_vector_matches_autodiff(rng):
+    x, y, offs, wts = make_problem(rng)
+    batch = batch_from_dense(x, y, offs, wts, dtype=jnp.float64)
+    obj = GLMObjective(loss=LOGISTIC, batch=batch, l2=0.2)
+    w = jnp.asarray(rng.normal(size=x.shape[1]))
+    v = jnp.asarray(rng.normal(size=x.shape[1]))
+    hv_auto = jax.jvp(lambda c: jax.grad(obj.value)(c), (w,), (v,))[1]
+    np.testing.assert_allclose(
+        np.asarray(obj.hessian_vector(w, v)), np.asarray(hv_auto), rtol=1e-8
+    )
+
+
+def test_hessian_matrix_and_diag_consistent(rng):
+    x, y, offs, wts = make_problem(rng, n=32, d=5)
+    batch = batch_from_dense(x, y, offs, wts, dtype=jnp.float64)
+    obj = GLMObjective(loss=LOGISTIC, batch=batch, l2=0.1)
+    w = jnp.asarray(rng.normal(size=5))
+    h = np.asarray(obj.hessian_matrix(w))
+    # full Hessian via autodiff
+    h_auto = np.asarray(jax.hessian(obj.value)(w))
+    np.testing.assert_allclose(h, h_auto, rtol=1e-8)
+    np.testing.assert_allclose(np.asarray(obj.hessian_diagonal(w)), np.diag(h), rtol=1e-8)
+    # Hv consistency
+    v = jnp.asarray(np.ones(5))
+    np.testing.assert_allclose(np.asarray(obj.hessian_vector(w, v)), h @ np.ones(5), rtol=1e-8)
+
+
+def test_normalization_margin_invariance(rng):
+    """Objective in transformed space with raw data == objective on explicitly
+    normalized data (the whole point of the effective-coefficient algebra)."""
+    n, d = 50, 6
+    x = rng.normal(size=(n, d)) * 3 + 1.5
+    x[:, -1] = 1.0  # intercept column
+    y = (rng.uniform(size=n) < 0.5).astype(float)
+    means, var = x.mean(0), x.var(0)
+    norm = build_normalization(
+        "STANDARDIZATION", means, var, np.abs(x).max(0), intercept_index=d - 1,
+        dtype=jnp.float64,
+    )
+    w_t = jnp.asarray(rng.normal(size=d))
+
+    batch_raw = batch_from_dense(x, y, dtype=jnp.float64)
+    obj = GLMObjective(loss=LOGISTIC, batch=batch_raw, norm=norm)
+    v_impl, g_impl = obj.value_and_grad(w_t)
+
+    # explicit normalization: x' = (x - shift) * factor
+    factors = np.asarray(norm.factors)
+    shifts = np.asarray(norm.shifts)
+    x_norm = (x - shifts) * factors
+    v_ref, g_ref = reference_value_grad("logistic", x_norm, y, np.zeros(n), np.ones(n), np.asarray(w_t))
+    np.testing.assert_allclose(float(v_impl), v_ref, rtol=1e-10)
+    np.testing.assert_allclose(np.asarray(g_impl), g_ref, rtol=1e-8)
+
+    # Hv parity under normalization too
+    v = jnp.asarray(rng.normal(size=d))
+    obj_norm = GLMObjective(loss=LOGISTIC, batch=batch_from_dense(x_norm, y, dtype=jnp.float64))
+    np.testing.assert_allclose(
+        np.asarray(obj.hessian_vector(w_t, v)),
+        np.asarray(obj_norm.hessian_vector(w_t, v)),
+        rtol=1e-8,
+    )
+    np.testing.assert_allclose(
+        np.asarray(obj.hessian_diagonal(w_t)),
+        np.asarray(obj_norm.hessian_diagonal(w_t)),
+        rtol=1e-8,
+    )
+
+
+def test_model_space_round_trip(rng):
+    d = 6
+    x = rng.normal(size=(8, d))
+    x[:, 0] = 1.0
+    norm = build_normalization(
+        "STANDARDIZATION", x.mean(0), x.var(0) + 0.5, np.abs(x).max(0), intercept_index=0,
+        dtype=jnp.float64,
+    )
+    w = jnp.asarray(rng.normal(size=d))
+    back = norm.model_to_transformed_space(norm.model_to_original_space(w))
+    np.testing.assert_allclose(np.asarray(back), np.asarray(w), rtol=1e-12)
+    # margin invariance: w'.x' == w.x for w = toOriginal(w')
+    w_orig = norm.model_to_original_space(w)
+    x_norm = (x - np.asarray(norm.shifts)) * np.asarray(norm.factors)
+    np.testing.assert_allclose(x_norm @ np.asarray(w), x @ np.asarray(w_orig), rtol=1e-10)
+
+
+def test_zero_weight_rows_are_invisible(rng):
+    x, y, offs, wts = make_problem(rng, n=20)
+    batch_full = batch_from_dense(x, y, offs, wts, dtype=jnp.float64)
+    wts2 = wts.copy()
+    wts2[10:] = 0.0
+    batch_masked = batch_from_dense(x, y, offs, wts2, dtype=jnp.float64)
+    batch_small = batch_from_dense(x[:10], y[:10], offs[:10], wts[:10], dtype=jnp.float64)
+    w = jnp.asarray(rng.normal(size=x.shape[1]))
+    om = GLMObjective(loss=LOGISTIC, batch=batch_masked)
+    os_ = GLMObjective(loss=LOGISTIC, batch=batch_small)
+    np.testing.assert_allclose(float(om.value(w)), float(os_.value(w)), rtol=1e-12)
+    np.testing.assert_allclose(
+        np.asarray(om.gradient(w)), np.asarray(os_.gradient(w)), rtol=1e-10
+    )
+
+
+def test_variances(rng):
+    x, y, offs, wts = make_problem(rng, n=200, d=4)
+    batch = batch_from_dense(x, y, offs, wts, dtype=jnp.float64)
+    obj = GLMObjective(loss=LOGISTIC, batch=batch, l2=1.0)
+    w = jnp.asarray(rng.normal(size=4))
+    assert compute_variances(obj, w, "NONE") is None
+    simple = compute_variances(obj, w, "SIMPLE")
+    full = compute_variances(obj, w, "FULL")
+    h = np.asarray(obj.hessian_matrix(w))
+    np.testing.assert_allclose(np.asarray(simple), 1 / np.diag(h), rtol=1e-8)
+    np.testing.assert_allclose(np.asarray(full), np.diag(np.linalg.inv(h)), rtol=1e-8)

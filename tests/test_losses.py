@@ -1,0 +1,97 @@
+"""Loss-function unit tests: values and derivatives vs closed forms / numeric
+differentiation (mirrors the reference's photon-lib function/glm/*Test suites)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from photon_ml_tpu.ops import losses as L
+
+
+def numeric_dz(loss, z, y, eps=1e-6):
+    lp = loss.loss_and_dz(jnp.asarray(z + eps), jnp.asarray(y))[0]
+    lm = loss.loss_and_dz(jnp.asarray(z - eps), jnp.asarray(y))[0]
+    return (np.asarray(lp) - np.asarray(lm)) / (2 * eps)
+
+
+@pytest.mark.parametrize("name", ["logistic", "squared", "poisson", "smoothed_hinge"])
+def test_first_derivative_matches_numeric(name):
+    loss = L.get_loss(name)
+    zs = np.linspace(-4, 4, 41)
+    for y in (0.0, 1.0) if name != "poisson" else (0.0, 1.0, 3.0, 7.0):
+        z = jnp.asarray(zs)
+        _, dz = loss.loss_and_dz(z, jnp.full_like(z, y))
+        num = numeric_dz(loss, zs, np.full_like(zs, y))
+        # smoothed hinge has kinks at m in {0, 1}; skip points within eps of them
+        if name == "smoothed_hinge":
+            ymod = 1.0 if y > 0.5 else -1.0
+            m = ymod * zs
+            mask = (np.abs(m) > 1e-3) & (np.abs(m - 1) > 1e-3)
+        else:
+            mask = np.ones_like(zs, bool)
+        np.testing.assert_allclose(np.asarray(dz)[mask], num[mask], atol=1e-5)
+
+
+@pytest.mark.parametrize("name", ["logistic", "squared", "poisson"])
+def test_second_derivative_matches_numeric(name):
+    loss = L.get_loss(name)
+    zs = np.linspace(-3, 3, 31)
+    y = np.ones_like(zs)
+    _, dz_p = loss.loss_and_dz(jnp.asarray(zs + 1e-5), jnp.asarray(y))
+    _, dz_m = loss.loss_and_dz(jnp.asarray(zs - 1e-5), jnp.asarray(y))
+    num = (np.asarray(dz_p) - np.asarray(dz_m)) / 2e-5
+    d2 = loss.d2z(jnp.asarray(zs), jnp.asarray(y))
+    np.testing.assert_allclose(np.asarray(d2), num, atol=1e-4)
+
+
+def test_logistic_closed_form():
+    loss = L.LOGISTIC
+    z = jnp.asarray([0.0, 2.0, -2.0])
+    # positive label
+    l1, d1 = loss.loss_and_dz(z, jnp.ones(3))
+    np.testing.assert_allclose(np.asarray(l1), np.log1p(np.exp(-np.asarray(z))), rtol=1e-12)
+    # negative label
+    l0, d0 = loss.loss_and_dz(z, jnp.zeros(3))
+    np.testing.assert_allclose(np.asarray(l0), np.log1p(np.exp(np.asarray(z))), rtol=1e-12)
+    # derivative = sigmoid(z) - y
+    sig = 1 / (1 + np.exp(-np.asarray(z)))
+    np.testing.assert_allclose(np.asarray(d1), sig - 1, atol=1e-12)
+    np.testing.assert_allclose(np.asarray(d0), sig, atol=1e-12)
+
+
+def test_logistic_numerically_stable_at_extremes():
+    loss = L.LOGISTIC
+    z = jnp.asarray([1000.0, -1000.0])
+    l1, d1 = loss.loss_and_dz(z, jnp.ones(2))
+    assert np.all(np.isfinite(np.asarray(l1)))
+    assert np.all(np.isfinite(np.asarray(d1)))
+    np.testing.assert_allclose(np.asarray(l1), [0.0, 1000.0], atol=1e-9)
+
+
+def test_poisson_closed_form():
+    loss = L.POISSON
+    z = jnp.asarray([0.5, -0.5])
+    y = jnp.asarray([2.0, 0.0])
+    l, dz = loss.loss_and_dz(z, y)
+    np.testing.assert_allclose(np.asarray(l), np.exp([0.5, -0.5]) - np.asarray(y) * np.asarray(z), rtol=1e-12)
+    np.testing.assert_allclose(np.asarray(dz), np.exp([0.5, -0.5]) - np.asarray(y), rtol=1e-12)
+
+
+def test_smoothed_hinge_segments():
+    loss = L.SMOOTHED_HINGE
+    # y=1: m=z. z=-1 -> 0.5-(-1)=1.5 ; z=0.5 -> 0.5*0.25=0.125 ; z=2 -> 0
+    l, _ = loss.loss_and_dz(jnp.asarray([-1.0, 0.5, 2.0]), jnp.ones(3))
+    np.testing.assert_allclose(np.asarray(l), [1.5, 0.125, 0.0], rtol=1e-12)
+    # y=0 (treated as -1): m=-z
+    l0, _ = loss.loss_and_dz(jnp.asarray([-2.0, -0.5, 1.0]), jnp.zeros(3))
+    np.testing.assert_allclose(np.asarray(l0), [0.0, 0.125, 1.5], rtol=1e-12)
+
+
+def test_task_dispatch():
+    assert L.get_loss("logistic_regression") is L.LOGISTIC
+    assert L.get_loss("LINEAR_REGRESSION".lower()) is L.SQUARED
+    assert L.get_loss("poisson_regression") is L.POISSON
+    assert L.get_loss("smoothed_hinge_loss_linear_svm") is L.SMOOTHED_HINGE
+    with pytest.raises(KeyError):
+        L.get_loss("nope")
