@@ -1,0 +1,3 @@
+from .model_training import TrainedModel, select_best_model, train_glm_grid
+
+__all__ = ["TrainedModel", "train_glm_grid", "select_best_model"]

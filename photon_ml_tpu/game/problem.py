@@ -1,0 +1,109 @@
+"""GLM optimization problems: objective + optimizer + regularization in one unit.
+
+Reference: photon-api .../optimization/ —
+GeneralizedLinearOptimizationProblem.scala:45-162 (run / initializeZeroModel /
+de-normalization back to original space), DistributedOptimizationProblem
+(fixed effect: down-sampling hook, mutable reg weight for lambda sweeps,
+variance computation) and SingleNodeOptimizationProblem (per-entity local
+problems). On TPU both are this one class: "distributed" = the batch is
+sharded over the mesh, "single node" = the problem is one vmap lane.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from ..models.coefficients import Coefficients
+from ..models.glm import GeneralizedLinearModel, model_for_task
+from ..ops.features import LabeledBatch
+from ..ops.glm import GLMObjective, compute_variances
+from ..ops.losses import get_loss
+from ..ops.normalization import NormalizationContext
+from ..ops.regularization import NO_REGULARIZATION, RegularizationContext
+from ..optimize import OptimizerConfig, SolverResult, optimize
+
+Array = jax.Array
+
+
+@dataclasses.dataclass(frozen=True)
+class GLMOptimizationConfig:
+    """Per-coordinate optimization settings (reference:
+    CoordinateOptimizationConfiguration + OptimizerConfig)."""
+
+    optimizer: OptimizerConfig = dataclasses.field(default_factory=OptimizerConfig)
+    regularization: RegularizationContext = NO_REGULARIZATION
+    reg_weight: float = 0.0
+    down_sampling_rate: float = 1.0
+    variance_type: str = "NONE"  # NONE | SIMPLE | FULL
+
+    def with_reg_weight(self, w: float) -> "GLMOptimizationConfig":
+        return dataclasses.replace(self, reg_weight=w)
+
+    def solver_config(self) -> OptimizerConfig:
+        """OptimizerConfig with the regularization split applied
+        (OptimizerFactory.scala:30-74: L1/elastic-net -> OWLQN l1 weight)."""
+        return dataclasses.replace(
+            self.optimizer,
+            l1_weight=self.regularization.l1_weight(self.reg_weight),
+        )
+
+
+@dataclasses.dataclass(frozen=True)
+class GLMProblem:
+    """A ready-to-run training problem over one batch."""
+
+    task: str
+    config: GLMOptimizationConfig
+    normalization: Optional[NormalizationContext] = None
+
+    def objective(self, batch: LabeledBatch) -> GLMObjective:
+        return GLMObjective(
+            loss=get_loss(self.task),
+            batch=batch,
+            l2=self.config.regularization.l2_weight(self.config.reg_weight),
+            norm=self.normalization,
+        )
+
+    def run(
+        self,
+        batch: LabeledBatch,
+        initial_model: Optional[GeneralizedLinearModel] = None,
+    ) -> Tuple[GeneralizedLinearModel, SolverResult]:
+        """Train; returns (model in ORIGINAL space, solver result).
+
+        Normalization semantics parity (Optimizer.scala:161-185 +
+        GeneralizedLinearOptimizationProblem): warm-start coefficients are
+        mapped to the transformed space, optimization runs there, the final
+        coefficients map back.
+        """
+        obj = self.objective(batch)
+        dtype = batch.labels.dtype
+        if initial_model is not None:
+            w0 = jnp.asarray(initial_model.coefficients.means, dtype)
+            if self.normalization is not None:
+                w0 = self.normalization.model_to_transformed_space(w0)
+        else:
+            w0 = jnp.zeros(batch.dim, dtype)
+
+        result = optimize(
+            obj.value_and_grad, w0, self.config.solver_config(), hvp=obj.hessian_vector
+        )
+
+        variances = compute_variances(obj, result.coefficients, self.config.variance_type)
+
+        means = result.coefficients
+        if self.normalization is not None:
+            means = self.normalization.model_to_original_space(means)
+            # variances stay in transformed space in the reference as well
+
+        model = model_for_task(
+            self.task, Coefficients(means=means, variances=variances)
+        )
+        return model, result
+
+    def zero_model(self, dim: int, dtype=jnp.float32) -> GeneralizedLinearModel:
+        return model_for_task(self.task, Coefficients.zeros(dim, dtype))

@@ -1,0 +1,32 @@
+from .avro import iter_avro_directory, parse_schema, read_avro_file, write_avro_file
+from .data import (
+    FeatureShardConfig,
+    RawDataset,
+    build_index_maps,
+    read_avro_dataset,
+    read_libsvm,
+    records_to_dataset,
+)
+from .index_map import INTERCEPT_KEY, IndexMap, feature_key, split_feature_key
+from .model_io import load_game_model, load_glm, save_game_model, save_glm
+
+__all__ = [
+    "read_avro_file",
+    "write_avro_file",
+    "iter_avro_directory",
+    "parse_schema",
+    "FeatureShardConfig",
+    "RawDataset",
+    "read_avro_dataset",
+    "read_libsvm",
+    "records_to_dataset",
+    "build_index_maps",
+    "IndexMap",
+    "INTERCEPT_KEY",
+    "feature_key",
+    "split_feature_key",
+    "save_glm",
+    "load_glm",
+    "save_game_model",
+    "load_game_model",
+]

@@ -1,0 +1,440 @@
+"""Pure-Python Avro binary codec: Object Container Files, read + write.
+
+The reference's wire format is Avro-on-HDFS (photon-avro-schemas/*.avsc,
+AvroDataReader/AvroUtils in photon-client). This environment has no avro/
+fastavro package, so the codec is implemented from the Avro 1.x specification:
+
+- zigzag-varint ints/longs, little-endian float/double, length-prefixed
+  bytes/string, records as concatenated fields, arrays/maps as count-prefixed
+  blocks (negative count => byte size follows), unions as branch-index +
+  value, enums as int index, fixed as raw bytes;
+- Object Container Files: magic ``Obj\\x01``, file-metadata map with
+  ``avro.schema`` / ``avro.codec``, 16-byte sync marker, then
+  (count, size, payload, sync) blocks; codecs ``null`` and ``deflate``
+  (raw zlib, wbits=-15).
+
+Schema resolution between writer and reader schemas is not implemented;
+records decode with their writer schema (how the reference uses Avro too —
+generic records + field lookups, AvroUtils.scala).
+
+Decoding is the host-side IO hot path that feeds the TPU; the pure-Python
+loop is enough to saturate a single chip for the benchmark datasets, and the
+record layer is deliberately isolated (``_read_datum``/``_write_datum``) so a
+C++ decode kernel can replace it without touching callers.
+"""
+
+from __future__ import annotations
+
+import io as _io
+import json
+import os
+import struct
+import zlib
+from typing import Any, Dict, Iterable, Iterator, List, Optional, Tuple, Union
+
+MAGIC = b"Obj\x01"
+SYNC_SIZE = 16
+_PRIMITIVES = {"null", "boolean", "int", "long", "float", "double", "bytes", "string"}
+
+Schema = Union[str, dict, list]
+
+
+# ---------------------------------------------------------------------------
+# schema handling
+# ---------------------------------------------------------------------------
+
+
+class SchemaEnv:
+    """Named-type registry for record/enum/fixed references."""
+
+    def __init__(self):
+        self.named: Dict[str, dict] = {}
+
+    def register(self, schema: dict):
+        name = schema.get("name")
+        if name:
+            ns = schema.get("namespace")
+            full = f"{ns}.{name}" if ns and "." not in name else name
+            self.named[full] = schema
+            self.named[name.split(".")[-1]] = schema
+
+    def resolve(self, schema: Schema) -> Schema:
+        if isinstance(schema, str) and schema not in _PRIMITIVES:
+            if schema in self.named:
+                return self.named[schema]
+            short = schema.split(".")[-1]
+            if short in self.named:
+                return self.named[short]
+            raise ValueError(f"Unknown named type: {schema}")
+        return schema
+
+
+def _walk_register(schema: Schema, env: SchemaEnv):
+    if isinstance(schema, dict):
+        t = schema.get("type")
+        if t in ("record", "error"):
+            env.register(schema)
+            for f in schema["fields"]:
+                _walk_register(f["type"], env)
+        elif t in ("enum", "fixed"):
+            env.register(schema)
+        elif t == "array":
+            _walk_register(schema["items"], env)
+        elif t == "map":
+            _walk_register(schema["values"], env)
+    elif isinstance(schema, list):
+        for s in schema:
+            _walk_register(s, env)
+
+
+def parse_schema(schema: Union[str, Schema]) -> Tuple[Schema, SchemaEnv]:
+    if isinstance(schema, str) and (schema.lstrip()[:1] in "{["):
+        schema = json.loads(schema)
+    env = SchemaEnv()
+    _walk_register(schema, env)
+    return schema, env
+
+
+# ---------------------------------------------------------------------------
+# binary decoder
+# ---------------------------------------------------------------------------
+
+
+class _Reader:
+    __slots__ = ("buf", "pos")
+
+    def __init__(self, data: bytes):
+        self.buf = data
+        self.pos = 0
+
+    def read(self, n: int) -> bytes:
+        p = self.pos
+        self.pos = p + n
+        return self.buf[p : p + n]
+
+    def read_long(self) -> int:
+        b = self.buf
+        p = self.pos
+        shift = 0
+        acc = 0
+        while True:
+            byte = b[p]
+            p += 1
+            acc |= (byte & 0x7F) << shift
+            if byte < 0x80:
+                break
+            shift += 7
+        self.pos = p
+        return (acc >> 1) ^ -(acc & 1)
+
+    def read_float(self) -> float:
+        return struct.unpack("<f", self.read(4))[0]
+
+    def read_double(self) -> float:
+        return struct.unpack("<d", self.read(8))[0]
+
+    def read_bytes(self) -> bytes:
+        return self.read(self.read_long())
+
+    def read_string(self) -> str:
+        return self.read_bytes().decode("utf-8")
+
+    def at_end(self) -> bool:
+        return self.pos >= len(self.buf)
+
+
+def _read_datum(r: _Reader, schema: Schema, env: SchemaEnv) -> Any:
+    schema = env.resolve(schema)
+    if isinstance(schema, str):
+        t = schema
+    elif isinstance(schema, list):
+        idx = r.read_long()
+        return _read_datum(r, schema[idx], env)
+    else:
+        t = schema["type"]
+        if isinstance(t, (dict, list)):
+            return _read_datum(r, t, env)
+
+    if t == "null":
+        return None
+    if t == "boolean":
+        return r.read(1) == b"\x01"
+    if t in ("int", "long"):
+        return r.read_long()
+    if t == "float":
+        return r.read_float()
+    if t == "double":
+        return r.read_double()
+    if t == "bytes":
+        return r.read_bytes()
+    if t == "string":
+        return r.read_string()
+    if t == "record" or t == "error":
+        return {
+            f["name"]: _read_datum(r, f["type"], env) for f in schema["fields"]
+        }
+    if t == "enum":
+        return schema["symbols"][r.read_long()]
+    if t == "fixed":
+        return r.read(schema["size"])
+    if t == "array":
+        out: List[Any] = []
+        while True:
+            count = r.read_long()
+            if count == 0:
+                break
+            if count < 0:
+                r.read_long()  # byte size, unused
+                count = -count
+            items = schema["items"]
+            for _ in range(count):
+                out.append(_read_datum(r, items, env))
+        return out
+    if t == "map":
+        m: Dict[str, Any] = {}
+        while True:
+            count = r.read_long()
+            if count == 0:
+                break
+            if count < 0:
+                r.read_long()
+                count = -count
+            values = schema["values"]
+            for _ in range(count):
+                key = r.read_string()  # key must decode before the value
+                m[key] = _read_datum(r, values, env)
+        return m
+    if t == "union":
+        idx = r.read_long()
+        return _read_datum(r, schema["types"][idx], env)
+    raise ValueError(f"Unsupported Avro type: {t!r}")
+
+
+# ---------------------------------------------------------------------------
+# binary encoder
+# ---------------------------------------------------------------------------
+
+
+class _Writer:
+    __slots__ = ("out",)
+
+    def __init__(self):
+        self.out = _io.BytesIO()
+
+    def write(self, b: bytes):
+        self.out.write(b)
+
+    def write_long(self, n: int):
+        n = (n << 1) ^ (n >> 63) if n < 0 else n << 1
+        while True:
+            b = n & 0x7F
+            n >>= 7
+            if n:
+                self.out.write(bytes([b | 0x80]))
+            else:
+                self.out.write(bytes([b]))
+                break
+
+    def write_float(self, v: float):
+        self.out.write(struct.pack("<f", v))
+
+    def write_double(self, v: float):
+        self.out.write(struct.pack("<d", v))
+
+    def write_bytes(self, b: bytes):
+        self.write_long(len(b))
+        self.out.write(b)
+
+    def write_string(self, s: str):
+        self.write_bytes(s.encode("utf-8"))
+
+    def getvalue(self) -> bytes:
+        return self.out.getvalue()
+
+
+def _union_branch(schema: list, datum: Any, env: SchemaEnv) -> int:
+    """Pick the union branch for a datum (null vs first matching type)."""
+    for i, s in enumerate(schema):
+        rs = env.resolve(s)
+        t = rs if isinstance(rs, str) else rs.get("type")
+        if datum is None and t == "null":
+            return i
+        if datum is not None and t != "null":
+            if t == "string" and isinstance(datum, str):
+                return i
+            if t in ("int", "long") and isinstance(datum, int) and not isinstance(datum, bool):
+                return i
+            if t in ("float", "double") and isinstance(datum, (int, float)) and not isinstance(datum, bool):
+                return i
+            if t == "boolean" and isinstance(datum, bool):
+                return i
+            if t == "bytes" and isinstance(datum, bytes):
+                return i
+            if t in ("record", "error", "map") and isinstance(datum, dict):
+                return i
+            if t == "array" and isinstance(datum, (list, tuple)):
+                return i
+            if t in ("enum",) and isinstance(datum, str):
+                return i
+            if t == "fixed" and isinstance(datum, bytes):
+                return i
+    raise ValueError(f"No union branch for datum {datum!r} in {schema}")
+
+
+def _write_datum(w: _Writer, schema: Schema, datum: Any, env: SchemaEnv):
+    schema = env.resolve(schema)
+    if isinstance(schema, list):
+        idx = _union_branch(schema, datum, env)
+        w.write_long(idx)
+        _write_datum(w, schema[idx], datum, env)
+        return
+    t = schema if isinstance(schema, str) else schema["type"]
+    if isinstance(t, (dict, list)):
+        _write_datum(w, t, datum, env)
+        return
+
+    if t == "null":
+        return
+    if t == "boolean":
+        w.write(b"\x01" if datum else b"\x00")
+    elif t in ("int", "long"):
+        w.write_long(int(datum))
+    elif t == "float":
+        w.write_float(float(datum))
+    elif t == "double":
+        w.write_double(float(datum))
+    elif t == "bytes":
+        w.write_bytes(datum)
+    elif t == "string":
+        w.write_string(datum)
+    elif t in ("record", "error"):
+        for f in schema["fields"]:
+            name = f["name"]
+            if name in datum:
+                value = datum[name]
+            elif "default" in f:
+                value = f["default"]
+            else:
+                raise KeyError(f"Record missing field {name!r}")
+            _write_datum(w, f["type"], value, env)
+    elif t == "enum":
+        w.write_long(schema["symbols"].index(datum))
+    elif t == "fixed":
+        w.write(datum)
+    elif t == "array":
+        if datum:
+            w.write_long(len(datum))
+            for item in datum:
+                _write_datum(w, schema["items"], item, env)
+        w.write_long(0)
+    elif t == "map":
+        if datum:
+            w.write_long(len(datum))
+            for k, v in datum.items():
+                w.write_string(k)
+                _write_datum(w, schema["values"], v, env)
+        w.write_long(0)
+    else:
+        raise ValueError(f"Unsupported Avro type: {t!r}")
+
+
+# ---------------------------------------------------------------------------
+# object container files
+# ---------------------------------------------------------------------------
+
+
+def read_avro_file(path: str) -> Tuple[Schema, List[dict]]:
+    """Read one .avro Object Container File -> (writer schema, records)."""
+    with open(path, "rb") as f:
+        data = f.read()
+    r = _Reader(data)
+    if r.read(4) != MAGIC:
+        raise ValueError(f"{path}: not an Avro object container file")
+    meta_schema = {"type": "map", "values": "bytes"}
+    env0 = SchemaEnv()
+    meta = _read_datum(r, meta_schema, env0)
+    schema_json = meta["avro.schema"].decode("utf-8")
+    codec = meta.get("avro.codec", b"null").decode("utf-8")
+    schema, env = parse_schema(schema_json)
+    sync = r.read(SYNC_SIZE)
+
+    records: List[dict] = []
+    while not r.at_end():
+        count = r.read_long()
+        size = r.read_long()
+        payload = r.read(size)
+        if codec == "deflate":
+            payload = zlib.decompress(payload, -15)
+        elif codec != "null":
+            raise ValueError(f"Unsupported Avro codec: {codec}")
+        br = _Reader(payload)
+        for _ in range(count):
+            records.append(_read_datum(br, schema, env))
+        block_sync = r.read(SYNC_SIZE)
+        if block_sync != sync:
+            raise ValueError(f"{path}: sync marker mismatch (corrupt file)")
+    return schema, records
+
+
+def iter_avro_directory(path: str) -> Iterator[dict]:
+    """Read all part files of an Avro dataset directory (or a single file),
+    mirroring how the reference consumes HDFS output dirs."""
+    if os.path.isfile(path):
+        yield from read_avro_file(path)[1]
+        return
+    names = sorted(os.listdir(path))
+    for name in names:
+        if name.startswith((".", "_")) or not name.endswith(".avro"):
+            continue
+        yield from read_avro_file(os.path.join(path, name))[1]
+
+
+def write_avro_file(
+    path: str,
+    schema: Union[str, Schema],
+    records: Iterable[dict],
+    codec: str = "deflate",
+    sync_interval_records: int = 4000,
+):
+    schema_obj, env = parse_schema(schema)
+    schema_json = json.dumps(schema_obj)
+    sync = os.urandom(SYNC_SIZE)
+
+    header = _Writer()
+    header.write(MAGIC)
+    _write_datum(
+        header,
+        {"type": "map", "values": "bytes"},
+        {"avro.schema": schema_json.encode("utf-8"), "avro.codec": codec.encode("utf-8")},
+        env,
+    )
+    header.write(sync)
+
+    def flush_block(out, buf: _Writer, count: int):
+        if count == 0:
+            return
+        payload = buf.getvalue()
+        if codec == "deflate":
+            co = zlib.compressobj(level=6, wbits=-15)
+            payload = co.compress(payload) + co.flush()
+        elif codec != "null":
+            raise ValueError(f"Unsupported Avro codec: {codec}")
+        head = _Writer()
+        head.write_long(count)
+        head.write_long(len(payload))
+        out.write(head.getvalue())
+        out.write(payload)
+        out.write(sync)
+
+    with open(path, "wb") as out:
+        out.write(header.getvalue())
+        buf = _Writer()
+        count = 0
+        for rec in records:
+            _write_datum(buf, schema_obj, rec, env)
+            count += 1
+            if count >= sync_interval_records:
+                flush_block(out, buf, count)
+                buf = _Writer()
+                count = 0
+        flush_block(out, buf, count)

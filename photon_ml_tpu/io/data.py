@@ -1,0 +1,257 @@
+"""Data readers: Avro training examples / LIBSVM text -> columnar host dataset.
+
+The reference's AvroDataReader (photon-client .../data/avro/AvroDataReader.scala:54-490)
+decodes Avro rows into DataFrames with one sparse-vector column per *feature
+shard*, where a shard is the union of several *feature bags* (record fields
+holding FeatureAvro arrays), each feature identified by (name, term) and
+mapped through an IndexMap, with an intercept injected per shard
+(AvroDataReader.scala:336-338).
+
+Here the product is a host-side columnar ``RawDataset`` (numpy COO per shard +
+labels/offsets/weights/uids/id-tags) that converts to device ``LabeledBatch``es.
+Sample order is fixed at read time — coordinate score exchange is then pure
+elementwise array math (SURVEY.md §2.1 P7), no joins.
+
+Reads both the modern ``TrainingExampleAvro`` and the legacy metronome
+``TrainingExample`` shapes (unions of numeric types for label/weight/offset,
+optional term).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+from typing import Dict, Iterable, List, Mapping, Optional, Sequence, Tuple
+
+import numpy as np
+
+from .avro import iter_avro_directory
+from .index_map import INTERCEPT_KEY, IndexMap, feature_key
+
+
+@dataclasses.dataclass(frozen=True)
+class FeatureShardConfig:
+    """Which feature-bag columns feed a shard, and whether to add an intercept
+    (reference: FeatureShardConfiguration, GameDriver feature-shard params)."""
+
+    feature_bags: Tuple[str, ...]
+    has_intercept: bool = True
+
+
+@dataclasses.dataclass
+class RawDataset:
+    """Columnar host dataset: everything needed to build device batches."""
+
+    n_rows: int
+    labels: np.ndarray  # f8[n]
+    offsets: np.ndarray  # f8[n]
+    weights: np.ndarray  # f8[n]
+    shard_coo: Dict[str, Tuple[np.ndarray, np.ndarray, np.ndarray]]  # shard -> (rows, cols, vals)
+    shard_dims: Dict[str, int]
+    id_tags: Dict[str, np.ndarray]  # tag -> object array of per-row ids
+    uids: Optional[np.ndarray] = None
+
+    def to_batch(self, shard: str, dtype=None, layout: str = "auto"):
+        """Build a device LabeledBatch for one feature shard.
+
+        layout: 'dense' | 'sparse' | 'auto' (dense when d <= 4096).
+        """
+        import jax.numpy as jnp
+
+        from ..ops.features import batch_from_coo, batch_from_dense
+
+        dtype = dtype or jnp.float32
+        rows, cols, vals = self.shard_coo[shard]
+        d = self.shard_dims[shard]
+        if layout == "auto":
+            layout = "dense" if d <= 4096 else "sparse"
+        if layout == "dense":
+            x = np.zeros((self.n_rows, d), dtype=np.float64)
+            x[rows, cols] = vals
+            return batch_from_dense(x, self.labels, self.offsets, self.weights, dtype=dtype)
+        return batch_from_coo(
+            rows, cols, vals, self.labels, d, self.offsets, self.weights, dtype=dtype
+        )
+
+
+def _num(v, default: float) -> float:
+    return default if v is None else float(v)
+
+
+def _collect_bag(
+    rec: dict, bag: str
+) -> Iterable[Tuple[str, float]]:
+    for f in rec.get(bag) or ():
+        term = f.get("term")
+        yield feature_key(f["name"], "" if term is None else str(term)), float(f["value"])
+
+
+def build_index_maps(
+    records: Sequence[dict],
+    shard_configs: Mapping[str, FeatureShardConfig],
+) -> Dict[str, IndexMap]:
+    """One pass over the data: distinct feature keys per shard -> IndexMap
+    (the in-memory path of FeatureIndexingDriver / DefaultIndexMapLoader)."""
+    keys: Dict[str, set] = {s: set() for s in shard_configs}
+    for rec in records:
+        for shard, cfg in shard_configs.items():
+            bucket = keys[shard]
+            for bag in cfg.feature_bags:
+                for key, _ in _collect_bag(rec, bag):
+                    bucket.add(key)
+    return {
+        s: IndexMap.from_keys(keys[s], add_intercept=shard_configs[s].has_intercept)
+        for s in shard_configs
+    }
+
+
+def records_to_dataset(
+    records: Sequence[dict],
+    shard_configs: Mapping[str, FeatureShardConfig],
+    index_maps: Mapping[str, IndexMap],
+    id_tag_columns: Sequence[str] = (),
+    response_column: str = "label",
+) -> RawDataset:
+    """Decode Avro records into a RawDataset (AvroDataReader.readMerged
+    semantics: bags merged per shard, name+term -> index, intercept injected,
+    unknown features dropped)."""
+    n = len(records)
+    labels = np.zeros(n, dtype=np.float64)
+    offsets = np.zeros(n, dtype=np.float64)
+    weights = np.ones(n, dtype=np.float64)
+    uids: List[Optional[str]] = []
+    tags: Dict[str, List] = {t: [] for t in id_tag_columns}
+    coo: Dict[str, Tuple[List[int], List[int], List[float]]] = {
+        s: ([], [], []) for s in shard_configs
+    }
+
+    for i, rec in enumerate(records):
+        label = rec.get(response_column)
+        if label is None:
+            label = rec.get("response")
+        labels[i] = _num(label, 0.0)
+        offsets[i] = _num(rec.get("offset"), 0.0)
+        weights[i] = _num(rec.get("weight"), 1.0)
+        uid = rec.get("uid")
+        uids.append(None if uid is None else str(uid))
+        meta = rec.get("metadataMap") or {}
+        for t in id_tag_columns:
+            v = rec.get(t)
+            if v is None:
+                v = meta.get(t)
+            tags[t].append("" if v is None else str(v))
+
+        for shard, cfg in shard_configs.items():
+            imap = index_maps[shard]
+            rows, cols, vals = coo[shard]
+            for key, value in _merge_bags(rec, cfg.feature_bags):
+                j = imap.get_index(key)
+                if j >= 0:
+                    rows.append(i)
+                    cols.append(j)
+                    vals.append(value)
+            if cfg.has_intercept:
+                j = imap.get_index(INTERCEPT_KEY)
+                if j >= 0:
+                    rows.append(i)
+                    cols.append(j)
+                    vals.append(1.0)
+
+    return RawDataset(
+        n_rows=n,
+        labels=labels,
+        offsets=offsets,
+        weights=weights,
+        shard_coo={
+            s: (
+                np.asarray(r, dtype=np.int64),
+                np.asarray(c, dtype=np.int64),
+                np.asarray(v, dtype=np.float64),
+            )
+            for s, (r, c, v) in coo.items()
+        },
+        shard_dims={s: len(index_maps[s]) for s in shard_configs},
+        id_tags={t: np.asarray(v, dtype=object) for t, v in tags.items()},
+        uids=np.asarray(uids, dtype=object),
+    )
+
+
+def _merge_bags(rec: dict, bags: Tuple[str, ...]) -> Iterable[Tuple[str, float]]:
+    """Merge bag columns; duplicate (name, term) keys within a row keep the
+    last value (the reference declares duplicates undefined behavior). Dedup
+    applies in the single-bag case too so dense and ELL layouts agree."""
+    merged: Dict[str, float] = {}
+    for bag in bags:
+        for k, v in _collect_bag(rec, bag):
+            merged[k] = v
+    yield from merged.items()
+
+
+def read_avro_dataset(
+    path: str,
+    shard_configs: Mapping[str, FeatureShardConfig],
+    index_maps: Optional[Mapping[str, IndexMap]] = None,
+    id_tag_columns: Sequence[str] = (),
+    response_column: str = "label",
+) -> Tuple[RawDataset, Dict[str, IndexMap]]:
+    """Read an Avro file/directory into a RawDataset, building index maps from
+    the data when not supplied (DefaultIndexMapLoader path)."""
+    records = list(iter_avro_directory(path))
+    if index_maps is None:
+        index_maps = build_index_maps(records, shard_configs)
+    ds = records_to_dataset(
+        records, shard_configs, index_maps, id_tag_columns, response_column
+    )
+    return ds, dict(index_maps)
+
+
+# ---------------------------------------------------------------------------
+# LIBSVM (dev-scripts/libsvm_text_to_trainingexample_avro.py equivalent input)
+# ---------------------------------------------------------------------------
+
+
+def read_libsvm(
+    path: str, dim: Optional[int] = None, add_intercept: bool = True
+) -> RawDataset:
+    """Read LIBSVM text: ``<label> <idx>:<val> ...`` with {-1,+1} or {0,1}
+    labels; 1-based or 0-based indices both handled (max index defines d)."""
+    rows: List[int] = []
+    cols: List[int] = []
+    vals: List[float] = []
+    labels: List[float] = []
+    max_col = -1
+    with open(path) as f:
+        i = 0
+        for line in f:
+            parts = line.split()
+            if not parts:
+                continue
+            y = float(parts[0])
+            labels.append(1.0 if y > 0 else 0.0)
+            for tok in parts[1:]:
+                c, _, v = tok.partition(":")
+                ci = int(c)
+                rows.append(i)
+                cols.append(ci)
+                vals.append(float(v))
+                max_col = max(max_col, ci)
+            i += 1
+    n = len(labels)
+    d = dim if dim is not None else max_col + 1
+    if add_intercept:
+        for r in range(n):
+            rows.append(r)
+            cols.append(d)
+            vals.append(1.0)
+        d += 1
+    imap_dim = d
+    return RawDataset(
+        n_rows=n,
+        labels=np.asarray(labels),
+        offsets=np.zeros(n),
+        weights=np.ones(n),
+        shard_coo={"global": (np.asarray(rows), np.asarray(cols), np.asarray(vals))},
+        shard_dims={"global": imap_dim},
+        id_tags={},
+        uids=None,
+    )

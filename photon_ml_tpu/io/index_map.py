@@ -1,0 +1,167 @@
+"""Feature index maps: (name, term) feature identity -> dense column index.
+
+Reference: photon-api .../index/IndexMap.scala + DefaultIndexMap /
+PalDBIndexMap, and the NameAndTerm feature identity
+(photon-client .../data/avro/NameAndTerm.scala). Feature keys concatenate
+name + "\\u0001" + term; the intercept is the reserved key
+"(INTERCEPT)" + "\\u0001" + "" (Constants.scala:31-42).
+
+The in-memory map is a plain dict (DefaultIndexMap). The reference's PalDB
+off-heap store exists so thousands of JVM executors can mmap one immutable
+index; the TPU-native analogue is a flat binary file (sorted key blob +
+offsets, written once at indexing time) that loads zero-copy via numpy — see
+``save``/``load``. Index building at scale is a one-time host-side step
+(SURVEY.md §2.1 P11).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import struct
+from typing import Dict, Iterable, Iterator, List, Optional, Tuple
+
+import numpy as np
+
+DELIMITER = ""
+INTERCEPT_NAME = "(INTERCEPT)"
+INTERCEPT_TERM = ""
+INTERCEPT_KEY = INTERCEPT_NAME + DELIMITER + INTERCEPT_TERM
+
+_MAGIC = b"PHIDX001"
+
+
+def feature_key(name: str, term: str = "") -> str:
+    return name + DELIMITER + term
+
+
+def split_feature_key(key: str) -> Tuple[str, str]:
+    name, _, term = key.partition(DELIMITER)
+    return name, term
+
+
+class IndexMap:
+    """Immutable feature-key -> index bijection for one feature shard."""
+
+    def __init__(self, key_to_index: Dict[str, int]):
+        self._k2i = key_to_index
+        self._i2k: Optional[List[str]] = None
+
+    @property
+    def size(self) -> int:
+        return len(self._k2i)
+
+    def __len__(self) -> int:
+        return len(self._k2i)
+
+    def __contains__(self, key: str) -> bool:
+        return key in self._k2i
+
+    def get_index(self, key: str) -> int:
+        """-1 for unseen features (IndexMap.NULL_KEY semantics)."""
+        return self._k2i.get(key, -1)
+
+    def get_feature_name(self, index: int) -> Optional[str]:
+        if self._i2k is None:
+            i2k = [""] * len(self._k2i)
+            for k, i in self._k2i.items():
+                i2k[i] = k
+            self._i2k = i2k
+        return self._i2k[index] if 0 <= index < len(self._i2k) else None
+
+    @property
+    def intercept_index(self) -> Optional[int]:
+        idx = self.get_index(INTERCEPT_KEY)
+        return None if idx < 0 else idx
+
+    def keys(self) -> Iterator[str]:
+        return iter(self._k2i)
+
+    def items(self) -> Iterator[Tuple[str, int]]:
+        return iter(self._k2i.items())
+
+    # -- construction -------------------------------------------------------
+
+    @staticmethod
+    def from_keys(keys: Iterable[str], add_intercept: bool = True) -> "IndexMap":
+        uniq = sorted(set(keys) - {INTERCEPT_KEY})
+        if add_intercept:
+            uniq.append(INTERCEPT_KEY)
+        return IndexMap({k: i for i, k in enumerate(uniq)})
+
+    @staticmethod
+    def from_name_terms(
+        name_terms: Iterable[Tuple[str, str]], add_intercept: bool = True
+    ) -> "IndexMap":
+        return IndexMap.from_keys(
+            (feature_key(n, t) for n, t in name_terms), add_intercept
+        )
+
+    # -- binary store (PalDB-equivalent immutable index file) ---------------
+
+    def save(self, path: str):
+        """Write a flat binary store: header, i64 key-blob offsets, i64 global
+        indices, utf-8 key blob. Entry k's key is blob[offsets[k]:offsets[k+1]]
+        and maps to indices[k] — indices are stored explicitly, so a store may
+        hold any subset of a global map (hash partitions included). Loading is
+        one read + two numpy views (the "off-heap store" role of PalDBIndexMap)."""
+        items = sorted(self._k2i.items(), key=lambda kv: kv[1])
+        n = len(items)
+        encoded = [k.encode("utf-8") for k, _ in items]
+        indices = np.asarray([i for _, i in items], dtype=np.int64)
+        offsets = np.zeros(n + 1, dtype=np.int64)
+        np.cumsum([len(e) for e in encoded], out=offsets[1:])
+        with open(path, "wb") as f:
+            f.write(_MAGIC)
+            f.write(struct.pack("<q", n))
+            f.write(offsets.tobytes())
+            f.write(indices.tobytes())
+            f.write(b"".join(encoded))
+
+    @staticmethod
+    def load(path: str) -> "IndexMap":
+        with open(path, "rb") as f:
+            magic = f.read(8)
+            if magic != _MAGIC:
+                raise ValueError(f"{path}: bad index store magic {magic!r}")
+            (n,) = struct.unpack("<q", f.read(8))
+            offsets = np.frombuffer(f.read(8 * (n + 1)), dtype=np.int64)
+            indices = np.frombuffer(f.read(8 * n), dtype=np.int64)
+            blob = f.read()
+        k2i = {
+            blob[offsets[k] : offsets[k + 1]].decode("utf-8"): int(indices[k])
+            for k in range(n)
+        }
+        return IndexMap(k2i)
+
+
+def save_partitioned(index_map: IndexMap, out_dir: str, num_partitions: int, shard: str):
+    """Write the index as hash-partitioned stores + metadata, matching the
+    layout produced by FeatureIndexingDriver (one store per partition;
+    partition = hash(key) % n, PalDBIndexMap.scala:69-105 semantics)."""
+    os.makedirs(out_dir, exist_ok=True)
+    parts: List[Dict[str, int]] = [dict() for _ in range(num_partitions)]
+    for k, i in index_map.items():
+        parts[_partition(k, num_partitions)][k] = i
+    for p, mapping in enumerate(parts):
+        IndexMap(mapping).save(os.path.join(out_dir, f"index-{shard}-{p:05d}.bin"))
+    with open(os.path.join(out_dir, f"_index-{shard}-meta.json"), "w") as f:
+        json.dump({"shard": shard, "numPartitions": num_partitions, "size": len(index_map)}, f)
+
+
+def load_partitioned(out_dir: str, shard: str) -> IndexMap:
+    with open(os.path.join(out_dir, f"_index-{shard}-meta.json")) as f:
+        meta = json.load(f)
+    merged: Dict[str, int] = {}
+    for p in range(meta["numPartitions"]):
+        part = IndexMap.load(os.path.join(out_dir, f"index-{shard}-{p:05d}.bin"))
+        merged.update(part.items())
+    return IndexMap(merged)
+
+
+def _partition(key: str, n: int) -> int:
+    # deterministic across runs (unlike Python's salted hash)
+    h = 2166136261
+    for b in key.encode("utf-8"):
+        h = ((h ^ b) * 16777619) & 0xFFFFFFFF
+    return h % n

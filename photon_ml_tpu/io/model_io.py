@@ -1,0 +1,285 @@
+"""GAME / GLM model persistence in the reference's on-disk layout.
+
+Reference: photon-client .../data/avro/ModelProcessingUtils.scala:77-625.
+Layout (verified against the reference's checked-in fixture models):
+
+    modelDir/
+      model-metadata.json
+      fixed-effect/<coordinateId>/
+        id-info                      # line 1: feature shard id
+        coefficients/part-00000.avro # one BayesianLinearModelAvro record
+      random-effect/<coordinateId>/
+        id-info                      # line 1: random-effect type (id tag)
+                                     # line 2: feature shard id
+        coefficients/part-*.avro     # one record per entity (modelId = entity)
+
+Coefficients serialize as (name, term, value) triples through the shard's
+IndexMap, so models interoperate with Photon ML deployments.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from typing import Dict, Mapping, Optional, Tuple
+
+import jax.numpy as jnp
+import numpy as np
+
+from ..models.coefficients import Coefficients
+from ..models.game import FixedEffectModel, GameModel, RandomEffectModel
+from ..models.glm import GeneralizedLinearModel, model_for_task
+from .avro import iter_avro_directory, write_avro_file
+from .index_map import IndexMap, feature_key, split_feature_key
+from .schemas import BAYESIAN_LINEAR_MODEL_AVRO
+
+# Interop class names (reference: photon-api .../supervised/**)
+_MODEL_CLASS_NAMES = {
+    "logistic_regression": "com.linkedin.photon.ml.supervised.classification.LogisticRegressionModel",
+    "linear_regression": "com.linkedin.photon.ml.supervised.regression.LinearRegressionModel",
+    "poisson_regression": "com.linkedin.photon.ml.supervised.regression.PoissonRegressionModel",
+    "smoothed_hinge_loss_linear_svm": "com.linkedin.photon.ml.supervised.classification.SmoothedHingeLossLinearSVMModel",
+}
+_CLASS_NAME_TO_TASK = {v: k for k, v in _MODEL_CLASS_NAMES.items()}
+
+
+def _coefficients_to_record(
+    model_id: str,
+    means: np.ndarray,
+    variances: Optional[np.ndarray],
+    index_map: IndexMap,
+    task: str,
+    sparsity_threshold: float = 0.0,
+) -> dict:
+    def triples(vec):
+        out = []
+        for i in np.nonzero(np.abs(vec) > sparsity_threshold)[0]:
+            key = index_map.get_feature_name(int(i))
+            if key is None:
+                continue
+            name, term = split_feature_key(key)
+            out.append({"name": name, "term": term, "value": float(vec[i])})
+        return out
+
+    rec = {
+        "modelId": model_id,
+        "modelClass": _MODEL_CLASS_NAMES.get(task),
+        "means": triples(means),
+        "variances": None if variances is None else triples(variances),
+        "lossFunction": None,
+    }
+    return rec
+
+
+def _record_to_vector(rec_items, index_map: IndexMap, dim: int) -> np.ndarray:
+    vec = np.zeros(dim)
+    for t in rec_items:
+        key = feature_key(t["name"], t["term"])
+        idx = index_map.get_index(key)
+        if idx >= 0:
+            vec[idx] = t["value"]
+    return vec
+
+
+def save_glm(
+    path: str,
+    model: GeneralizedLinearModel,
+    index_map: IndexMap,
+    model_id: str = "",
+    sparsity_threshold: float = 0.0,
+):
+    """Write a single GLM as one BayesianLinearModelAvro record file."""
+    os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+    coef = model.coefficients
+    rec = _coefficients_to_record(
+        model_id,
+        np.asarray(coef.means),
+        None if coef.variances is None else np.asarray(coef.variances),
+        index_map,
+        type(model).task,
+        sparsity_threshold,
+    )
+    write_avro_file(path, BAYESIAN_LINEAR_MODEL_AVRO, [rec])
+
+
+def load_glm(path: str, index_map: IndexMap, task: Optional[str] = None):
+    recs = list(iter_avro_directory(path))
+    if len(recs) != 1:
+        raise ValueError(f"{path}: expected 1 model record, found {len(recs)}")
+    rec = recs[0]
+    task = task or _CLASS_NAME_TO_TASK.get(rec.get("modelClass") or "", "linear_regression")
+    dim = len(index_map)
+    means = _record_to_vector(rec["means"], index_map, dim)
+    variances = (
+        _record_to_vector(rec["variances"], index_map, dim)
+        if rec.get("variances")
+        else None
+    )
+    dt = jnp.asarray(0.0).dtype  # default float dtype (f32 on TPU, f64 under x64)
+    coef = Coefficients(
+        means=jnp.asarray(means, dt),
+        variances=None if variances is None else jnp.asarray(variances, dt),
+    )
+    return model_for_task(task, coef)
+
+
+def save_game_model(
+    model_dir: str,
+    game_model: GameModel,
+    index_maps: Mapping[str, IndexMap],
+    metadata: Optional[dict] = None,
+    sparsity_threshold: float = 0.0,
+    records_per_file: int = 100_000,
+):
+    os.makedirs(model_dir, exist_ok=True)
+    meta = {"modelType": game_model.task.upper(), **(metadata or {})}
+    with open(os.path.join(model_dir, "model-metadata.json"), "w") as f:
+        json.dump(meta, f, indent=2)
+
+    for name, sub in game_model.models.items():
+        if isinstance(sub, FixedEffectModel):
+            base = os.path.join(model_dir, "fixed-effect", name)
+            os.makedirs(os.path.join(base, "coefficients"), exist_ok=True)
+            with open(os.path.join(base, "id-info"), "w") as f:
+                f.write(sub.feature_shard + "\n")
+            save_glm(
+                os.path.join(base, "coefficients", "part-00000.avro"),
+                sub.model,
+                index_maps[sub.feature_shard],
+                model_id=name,
+                sparsity_threshold=sparsity_threshold,
+            )
+        elif isinstance(sub, RandomEffectModel):
+            base = os.path.join(model_dir, "random-effect", name)
+            os.makedirs(os.path.join(base, "coefficients"), exist_ok=True)
+            with open(os.path.join(base, "id-info"), "w") as f:
+                f.write(sub.random_effect_type + "\n" + sub.feature_shard + "\n")
+            imap = index_maps[sub.feature_shard]
+            idx = np.asarray(sub.coef_indices)
+            val = np.asarray(sub.coef_values)
+            var = None if sub.variances is None else np.asarray(sub.variances)
+
+            def entity_records():
+                for e, ent in enumerate(sub.entity_ids):
+                    m = idx[e] >= 0
+                    means = [
+                        {
+                            "name": (kv := split_feature_key(imap.get_feature_name(int(j))))[0],
+                            "term": kv[1],
+                            "value": float(v),
+                        }
+                        for j, v in zip(idx[e][m], val[e][m])
+                        if abs(v) > sparsity_threshold
+                    ]
+                    variances = None
+                    if var is not None:
+                        variances = [
+                            {
+                                "name": (kv := split_feature_key(imap.get_feature_name(int(j))))[0],
+                                "term": kv[1],
+                                "value": float(v),
+                            }
+                            for j, v in zip(idx[e][m], var[e][m])
+                        ]
+                    yield {
+                        "modelId": str(ent),
+                        "modelClass": _MODEL_CLASS_NAMES.get(sub.task),
+                        "means": means,
+                        "variances": variances,
+                        "lossFunction": None,
+                    }
+
+            # chunk into part files
+            part = 0
+            chunk = []
+            for rec in entity_records():
+                chunk.append(rec)
+                if len(chunk) >= records_per_file:
+                    write_avro_file(
+                        os.path.join(base, "coefficients", f"part-{part:05d}.avro"),
+                        BAYESIAN_LINEAR_MODEL_AVRO,
+                        chunk,
+                    )
+                    part += 1
+                    chunk = []
+            write_avro_file(
+                os.path.join(base, "coefficients", f"part-{part:05d}.avro"),
+                BAYESIAN_LINEAR_MODEL_AVRO,
+                chunk,
+            )
+        else:
+            raise TypeError(f"Unknown sub-model type for {name}: {type(sub)}")
+
+
+def load_game_model(
+    model_dir: str, index_maps: Mapping[str, IndexMap], task: Optional[str] = None
+) -> GameModel:
+    meta_path = os.path.join(model_dir, "model-metadata.json")
+    meta = {}
+    if os.path.exists(meta_path):
+        with open(meta_path) as f:
+            meta = json.load(f)
+    task = task or meta.get("modelType", "LINEAR_REGRESSION").lower()
+
+    models: Dict[str, object] = {}
+    fe_dir = os.path.join(model_dir, "fixed-effect")
+    if os.path.isdir(fe_dir):
+        for name in sorted(os.listdir(fe_dir)):
+            base = os.path.join(fe_dir, name)
+            if not os.path.isdir(base):
+                continue
+            with open(os.path.join(base, "id-info")) as f:
+                shard = f.readline().strip()
+            glm = load_glm(os.path.join(base, "coefficients"), index_maps[shard], task)
+            models[name] = FixedEffectModel(model=glm, feature_shard=shard)
+
+    re_dir = os.path.join(model_dir, "random-effect")
+    if os.path.isdir(re_dir):
+        for name in sorted(os.listdir(re_dir)):
+            base = os.path.join(re_dir, name)
+            if not os.path.isdir(base):
+                continue
+            with open(os.path.join(base, "id-info")) as f:
+                re_type = f.readline().strip()
+                shard = f.readline().strip()
+            imap = index_maps[shard]
+            ids, vecs, variances = [], [], []
+            has_var = False
+            for rec in iter_avro_directory(os.path.join(base, "coefficients")):
+                ids.append(rec["modelId"])
+                items = [
+                    (imap.get_index(feature_key(t["name"], t["term"])), t["value"])
+                    for t in rec["means"]
+                ]
+                vecs.append([(i, v) for i, v in items if i >= 0])
+                if rec.get("variances"):
+                    has_var = True
+                    vitems = [
+                        (imap.get_index(feature_key(t["name"], t["term"])), t["value"])
+                        for t in rec["variances"]
+                    ]
+                    variances.append({i: v for i, v in vitems if i >= 0})
+                else:
+                    variances.append({})
+            S = max((len(v) for v in vecs), default=1) or 1
+            E = len(ids)
+            idx = np.full((E, S), -1, dtype=np.int32)
+            val = np.zeros((E, S))
+            var = np.zeros((E, S)) if has_var else None
+            for e, items in enumerate(vecs):
+                items.sort()
+                for k, (i, v) in enumerate(items):
+                    idx[e, k] = i
+                    val[e, k] = v
+                    if var is not None:
+                        var[e, k] = variances[e].get(i, 0.0)
+            models[name] = RandomEffectModel(
+                random_effect_type=re_type,
+                feature_shard=shard,
+                task=task,
+                entity_ids=np.asarray(ids, dtype=object),
+                coef_indices=jnp.asarray(idx),
+                coef_values=jnp.asarray(val, jnp.asarray(0.0).dtype),
+                variances=None if var is None else jnp.asarray(var, jnp.asarray(0.0).dtype),
+            )
+    return GameModel(models=models, task=task)

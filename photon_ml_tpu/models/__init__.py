@@ -1,0 +1,21 @@
+from .coefficients import Coefficients
+from .glm import (
+    GeneralizedLinearModel,
+    LinearRegressionModel,
+    LogisticRegressionModel,
+    MODEL_CLASSES,
+    PoissonRegressionModel,
+    SmoothedHingeLossLinearSVMModel,
+    model_for_task,
+)
+
+__all__ = [
+    "Coefficients",
+    "GeneralizedLinearModel",
+    "LogisticRegressionModel",
+    "LinearRegressionModel",
+    "PoissonRegressionModel",
+    "SmoothedHingeLossLinearSVMModel",
+    "MODEL_CLASSES",
+    "model_for_task",
+]

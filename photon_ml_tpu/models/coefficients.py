@@ -1,0 +1,36 @@
+"""Model coefficients: means + optional variances.
+
+Reference: photon-lib .../model/Coefficients.scala:31-141. Dense jnp arrays
+(the TPU frame: even "sparse" models score as dense vectors per feature shard;
+huge feature spaces are handled by sharding the vector over the mesh, not by
+hash maps).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+Array = jax.Array
+
+
+@jax.tree_util.register_dataclass
+@dataclasses.dataclass(frozen=True)
+class Coefficients:
+    means: Array
+    variances: Optional[Array] = None
+
+    @property
+    def dim(self) -> int:
+        return self.means.shape[-1]
+
+    def score(self, features_matvec) -> Array:
+        """Dot-product scoring given a FeatureMatrix-like matvec callable."""
+        return features_matvec(self.means)
+
+    @staticmethod
+    def zeros(dim: int, dtype=jnp.float32) -> "Coefficients":
+        return Coefficients(means=jnp.zeros(dim, dtype))

@@ -1,0 +1,138 @@
+"""GAME model classes: fixed-effect, random-effect, and composite GAME models.
+
+Reference: photon-lib/.../model/ — GameModel (map coordinateId -> model,
+scores summed across coordinates, GameModel.scala:99-104), FixedEffectModel
+(broadcast coefficients + dot products, FixedEffectModel.scala:55),
+RandomEffectModel (per-entity coefficient lookup joined by entity id, score 0
+for unseen entities, RandomEffectModel.scala:70,254+).
+
+TPU re-design: a random-effect model is a *padded per-entity sparse matrix*
+(entity-major ``coef_indices i32[E, S]`` / ``coef_values f32[E, S]``, indices
+into the shard's global feature space, padded with -1) — the device-friendly
+form of the reference's RDD[(entityId, GLM)]. Host keeps the entityId -> row
+dict. Scoring gathers the entity row then dot-products in the entity's
+subspace; unseen entities contribute 0.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Dict, List, Mapping, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..ops.features import LabeledBatch
+from .coefficients import Coefficients
+from .glm import GeneralizedLinearModel, model_for_task
+
+Array = jax.Array
+
+
+@dataclasses.dataclass(frozen=True)
+class FixedEffectModel:
+    """One GLM applied to every sample's features from one feature shard."""
+
+    model: GeneralizedLinearModel
+    feature_shard: str
+
+    @property
+    def coefficients(self) -> Coefficients:
+        return self.model.coefficients
+
+    def score(self, batch: LabeledBatch) -> Array:
+        """Margins WITHOUT the batch offset: coordinate scores compose by
+        summation, offsets are added once by the consumer."""
+        return batch.features.matvec(self.model.coefficients.means)
+
+
+@dataclasses.dataclass
+class RandomEffectModel:
+    """Per-entity GLMs for one random-effect type over one feature shard."""
+
+    random_effect_type: str  # id-tag column, e.g. "userId"
+    feature_shard: str
+    task: str
+    entity_ids: np.ndarray  # object[E] host-side ids (row order of the arrays)
+    coef_indices: Array  # i32[E, S] global feature indices, -1 padded
+    coef_values: Array  # f[E, S]
+    variances: Optional[Array] = None  # f[E, S] if computed
+    _id_to_row: Optional[Dict[str, int]] = dataclasses.field(default=None, repr=False)
+
+    def __post_init__(self):
+        if self._id_to_row is None:
+            self._id_to_row = {str(e): i for i, e in enumerate(self.entity_ids)}
+
+    @property
+    def num_entities(self) -> int:
+        return len(self.entity_ids)
+
+    def entity_row(self, entity_id: str) -> int:
+        """Row index for an entity, -1 if unseen."""
+        return self._id_to_row.get(str(entity_id), -1)
+
+    def rows_for(self, entity_ids: Sequence) -> np.ndarray:
+        return np.asarray([self.entity_row(e) for e in entity_ids], dtype=np.int64)
+
+    def dense_coefficients(self, dim: int) -> np.ndarray:
+        """Materialize [E, dim] dense coefficients (small models / tests)."""
+        out = np.zeros((self.num_entities, dim))
+        idx = np.asarray(self.coef_indices)
+        val = np.asarray(self.coef_values)
+        for e in range(self.num_entities):
+            m = idx[e] >= 0
+            out[e, idx[e][m]] = val[e][m]
+        return out
+
+    def score_ell_rows(
+        self, entity_rows: Array, feat_idx: Array, feat_val: Array
+    ) -> Array:
+        """Score rows in ELL layout: row i gets features (feat_idx[i], feat_val[i])
+        and entity row entity_rows[i] (-1 => unseen => score 0).
+
+        Per row: score = sum_k feat_val[k] * w_e[feat_idx[k]], where w_e is the
+        entity's sparse vector; the lookup is a searchsorted into the entity's
+        sorted support (coef_indices rows are sorted ascending with -1 padding
+        moved to the FRONT so valid entries form the sorted suffix... indices
+        are stored sorted ascending with -1 padding at the END replaced by a
+        large sentinel during search).
+        """
+        safe_rows = jnp.maximum(entity_rows, 0)
+        ent_idx = jnp.take(self.coef_indices, safe_rows, axis=0)  # [n, S]
+        ent_val = jnp.take(self.coef_values, safe_rows, axis=0)  # [n, S]
+        big = jnp.iinfo(jnp.int32).max
+        ent_idx_search = jnp.where(ent_idx < 0, big, ent_idx)
+
+        def one(ei, ev, fi, fv):
+            pos = jnp.searchsorted(ei, fi)
+            pos = jnp.clip(pos, 0, ei.shape[0] - 1)
+            hit = jnp.take(ei, pos) == fi
+            w = jnp.where(hit, jnp.take(ev, pos), 0.0)
+            return jnp.sum(w * fv)
+
+        scores = jax.vmap(one)(ent_idx_search, ent_val, feat_idx, feat_val)
+        return jnp.where(entity_rows >= 0, scores, 0.0)
+
+
+@dataclasses.dataclass
+class GameModel:
+    """coordinateId -> model; total score = sum of coordinate scores
+    (GameModel.scala:99-104)."""
+
+    models: Dict[str, object]  # FixedEffectModel | RandomEffectModel
+    task: str = "logistic_regression"
+
+    def __getitem__(self, name: str):
+        return self.models[name]
+
+    def __contains__(self, name: str) -> bool:
+        return name in self.models
+
+    def coordinates(self) -> List[str]:
+        return list(self.models)
+
+    def updated(self, name: str, model) -> "GameModel":
+        new = dict(self.models)
+        new[name] = model
+        return GameModel(models=new, task=self.task)

@@ -1,0 +1,108 @@
+"""Generalized linear model classes.
+
+Reference: photon-api .../supervised/** — GeneralizedLinearModel subclasses
+each defining the mean (inverse-link) function:
+LogisticRegressionModel (sigmoid, also a binary classifier with threshold),
+LinearRegressionModel (identity), PoissonRegressionModel (exp),
+SmoothedHingeLossLinearSVMModel (identity margin, binary classifier).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import ClassVar, Optional
+
+import jax
+import jax.numpy as jnp
+
+from ..ops.features import LabeledBatch
+from ..ops.losses import LOGISTIC, POISSON, SMOOTHED_HINGE, SQUARED, PointwiseLoss
+from .coefficients import Coefficients
+
+Array = jax.Array
+
+
+@jax.tree_util.register_dataclass
+@dataclasses.dataclass(frozen=True)
+class GeneralizedLinearModel:
+    """Base GLM: coefficients + the task's mean function.
+
+    ``score(batch)`` is the raw margin (features.coef + offset);
+    ``predict_mean`` applies the inverse link.
+    """
+
+    coefficients: Coefficients
+    task: ClassVar[str] = "none"
+    loss: ClassVar[Optional[PointwiseLoss]] = None
+
+    def score(self, batch: LabeledBatch) -> Array:
+        return batch.margins(self.coefficients.means)
+
+    def compute_mean(self, margins: Array) -> Array:
+        raise NotImplementedError
+
+    def predict_mean(self, batch: LabeledBatch) -> Array:
+        return self.compute_mean(self.score(batch))
+
+
+@jax.tree_util.register_dataclass
+@dataclasses.dataclass(frozen=True)
+class LogisticRegressionModel(GeneralizedLinearModel):
+    task: ClassVar[str] = "logistic_regression"
+    loss: ClassVar[PointwiseLoss] = LOGISTIC
+
+    def compute_mean(self, margins: Array) -> Array:
+        return jax.nn.sigmoid(margins)
+
+    def predict_class(self, batch: LabeledBatch, threshold: float = 0.5) -> Array:
+        return (self.predict_mean(batch) > threshold).astype(jnp.int32)
+
+
+@jax.tree_util.register_dataclass
+@dataclasses.dataclass(frozen=True)
+class LinearRegressionModel(GeneralizedLinearModel):
+    task: ClassVar[str] = "linear_regression"
+    loss: ClassVar[PointwiseLoss] = SQUARED
+
+    def compute_mean(self, margins: Array) -> Array:
+        return margins
+
+
+@jax.tree_util.register_dataclass
+@dataclasses.dataclass(frozen=True)
+class PoissonRegressionModel(GeneralizedLinearModel):
+    task: ClassVar[str] = "poisson_regression"
+    loss: ClassVar[PointwiseLoss] = POISSON
+
+    def compute_mean(self, margins: Array) -> Array:
+        return jnp.exp(margins)
+
+
+@jax.tree_util.register_dataclass
+@dataclasses.dataclass(frozen=True)
+class SmoothedHingeLossLinearSVMModel(GeneralizedLinearModel):
+    task: ClassVar[str] = "smoothed_hinge_loss_linear_svm"
+    loss: ClassVar[PointwiseLoss] = SMOOTHED_HINGE
+
+    def compute_mean(self, margins: Array) -> Array:
+        return margins
+
+    def predict_class(self, batch: LabeledBatch, threshold: float = 0.0) -> Array:
+        return (self.score(batch) > threshold).astype(jnp.int32)
+
+
+MODEL_CLASSES = {
+    "logistic_regression": LogisticRegressionModel,
+    "linear_regression": LinearRegressionModel,
+    "poisson_regression": PoissonRegressionModel,
+    "smoothed_hinge_loss_linear_svm": SmoothedHingeLossLinearSVMModel,
+}
+
+
+def model_for_task(task: str, coefficients: Coefficients) -> GeneralizedLinearModel:
+    """Task-type -> model dispatch (reference: GeneralizedLinearModel factories)."""
+    try:
+        cls = MODEL_CLASSES[task.lower()]
+    except KeyError:
+        raise KeyError(f"Unknown training task: {task!r}") from None
+    return cls(coefficients=coefficients)

@@ -1,0 +1,41 @@
+"""Regularization contexts: NONE / L1 / L2 / ELASTIC_NET.
+
+Reference: photon-lib .../optimization/RegularizationContext.scala:38-134 —
+elastic net splits a total weight lambda into alpha*lambda L1 + (1-alpha)*lambda L2;
+L2 folds into the objective, L1 is handled by the OWL-QN solver.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+
+@dataclasses.dataclass(frozen=True)
+class RegularizationContext:
+    reg_type: str = "NONE"  # NONE | L1 | L2 | ELASTIC_NET
+    elastic_net_alpha: float = 1.0  # fraction of weight on L1 for ELASTIC_NET
+
+    def __post_init__(self):
+        t = self.reg_type.upper()
+        if t not in ("NONE", "L1", "L2", "ELASTIC_NET"):
+            raise ValueError(f"Unknown regularization type: {self.reg_type!r}")
+        object.__setattr__(self, "reg_type", t)
+        if t == "ELASTIC_NET" and not (0.0 <= self.elastic_net_alpha <= 1.0):
+            raise ValueError(f"elastic net alpha must be in [0,1]: {self.elastic_net_alpha}")
+
+    def l1_weight(self, reg_weight: float) -> float:
+        if self.reg_type == "L1":
+            return reg_weight
+        if self.reg_type == "ELASTIC_NET":
+            return self.elastic_net_alpha * reg_weight
+        return 0.0
+
+    def l2_weight(self, reg_weight: float) -> float:
+        if self.reg_type == "L2":
+            return reg_weight
+        if self.reg_type == "ELASTIC_NET":
+            return (1.0 - self.elastic_net_alpha) * reg_weight
+        return 0.0
+
+
+NO_REGULARIZATION = RegularizationContext("NONE")

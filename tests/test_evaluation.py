@@ -1,0 +1,111 @@
+"""Evaluator tests vs sklearn and hand-computed values (mirrors the
+reference's evaluation unit suites, incl. tie and weight handling)."""
+
+import numpy as np
+import pytest
+
+from photon_ml_tpu.evaluation import (
+    area_under_pr_curve,
+    area_under_roc_curve,
+    build_evaluator,
+    build_suite,
+    grouped_evaluate,
+    precision_at_k,
+    rmse,
+)
+
+
+def test_auc_matches_sklearn(rng):
+    from sklearn.metrics import roc_auc_score
+
+    for _ in range(5):
+        y = (rng.uniform(size=200) < 0.4).astype(float)
+        s = rng.normal(size=200) + y
+        np.testing.assert_allclose(
+            area_under_roc_curve(s, y), roc_auc_score(y, s), rtol=1e-12
+        )
+
+
+def test_auc_weighted_matches_sklearn(rng):
+    from sklearn.metrics import roc_auc_score
+
+    y = (rng.uniform(size=300) < 0.3).astype(float)
+    s = rng.normal(size=300) + 0.8 * y
+    w = rng.uniform(0.1, 3.0, size=300)
+    np.testing.assert_allclose(
+        area_under_roc_curve(s, y, w), roc_auc_score(y, s, sample_weight=w), rtol=1e-10
+    )
+
+
+def test_auc_with_ties(rng):
+    from sklearn.metrics import roc_auc_score
+
+    y = (rng.uniform(size=400) < 0.5).astype(float)
+    s = np.round(rng.normal(size=400), 1)  # heavy ties
+    np.testing.assert_allclose(area_under_roc_curve(s, y), roc_auc_score(y, s), rtol=1e-12)
+
+
+def test_auc_single_class_is_nan():
+    assert np.isnan(area_under_roc_curve([1.0, 2.0], [1.0, 1.0]))
+
+
+def test_aupr_close_to_sklearn(rng):
+    from sklearn.metrics import average_precision_score
+
+    y = (rng.uniform(size=500) < 0.3).astype(float)
+    s = rng.normal(size=500) + y
+    # trapezoidal AUPR vs step-wise AP differ slightly by construction
+    assert abs(area_under_pr_curve(s, y) - average_precision_score(y, s)) < 0.02
+
+
+def test_rmse():
+    np.testing.assert_allclose(rmse([1.0, 3.0], [0.0, 0.0]), np.sqrt(5.0))
+    np.testing.assert_allclose(rmse([1.0, 3.0], [0.0, 0.0], [1.0, 0.0]), 1.0)
+
+
+def test_precision_at_k():
+    s = [0.9, 0.8, 0.7, 0.6]
+    y = [1.0, 0.0, 1.0, 1.0]
+    assert precision_at_k(1, s, y) == 1.0
+    assert precision_at_k(2, s, y) == 0.5
+    assert precision_at_k(4, s, y) == 0.75
+
+
+def test_grouped_auc():
+    # two groups; group B has one class -> dropped
+    gid = np.asarray(["a", "a", "a", "a", "b", "b"])
+    s = np.asarray([0.1, 0.9, 0.4, 0.6, 0.5, 0.7])
+    y = np.asarray([0.0, 1.0, 0.0, 1.0, 1.0, 1.0])
+    v = grouped_evaluate(area_under_roc_curve, gid, s, y)
+    np.testing.assert_allclose(v, 1.0)
+
+
+def test_build_evaluator_specs():
+    assert build_evaluator("AUC").higher_is_better
+    assert not build_evaluator("rmse").higher_is_better
+    e = build_evaluator("PRECISION@5:userId")
+    assert e.group_by == "userId" and e.name == "PRECISION@5:userId"
+    e2 = build_evaluator("AUC:songId")
+    assert e2.group_by == "songId"
+    with pytest.raises(ValueError):
+        build_evaluator("bogus")
+
+
+def test_better_handles_nan():
+    e = build_evaluator("AUC")
+    assert e.better(0.5, float("nan"))
+    assert not e.better(float("nan"), 0.5)
+    assert e.better(0.7, 0.5)
+    r = build_evaluator("RMSE")
+    assert r.better(0.5, 0.7)
+
+
+def test_suite(rng):
+    y = (rng.uniform(size=100) < 0.5).astype(float)
+    s = rng.normal(size=100) + y
+    gid = np.asarray([f"g{i%3}" for i in range(100)])
+    suite = build_suite(["AUC", "RMSE", "AUC:userId"], y, id_tags={"userId": gid})
+    res = suite.evaluate(s)
+    assert res.primary_name == "AUC"
+    assert set(res.metrics) == {"AUC", "RMSE", "AUC:userId"}
+    assert 0.5 < res.metrics["AUC"] <= 1.0

@@ -1,0 +1,193 @@
+"""IO tests: Avro codec round-trips (incl. against real reference fixtures),
+index maps, LIBSVM and Avro dataset readers, model save/load."""
+
+import os
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from photon_ml_tpu.io import (
+    FeatureShardConfig,
+    INTERCEPT_KEY,
+    IndexMap,
+    feature_key,
+    load_game_model,
+    load_glm,
+    read_avro_dataset,
+    read_avro_file,
+    read_libsvm,
+    save_game_model,
+    save_glm,
+    write_avro_file,
+)
+from photon_ml_tpu.io.schemas import TRAINING_EXAMPLE_AVRO
+from photon_ml_tpu.models import Coefficients, LogisticRegressionModel
+from photon_ml_tpu.models.game import FixedEffectModel, GameModel, RandomEffectModel
+
+REFERENCE_FIXTURES = "/root/reference/photon-client/src/integTest/resources"
+
+
+def _mk_records(n=25, d=6, seed=0):
+    rng = np.random.default_rng(seed)
+    recs = []
+    for i in range(n):
+        nnz = rng.integers(1, d)
+        cols = rng.choice(d, size=nnz, replace=False)
+        recs.append(
+            {
+                "uid": f"uid{i}",
+                "label": float(rng.integers(0, 2)),
+                "features": [
+                    {"name": f"f{c}", "term": "t", "value": float(rng.normal())}
+                    for c in cols
+                ],
+                "metadataMap": {"userId": f"u{i % 5}"},
+                "weight": 1.0 + float(rng.uniform()),
+                "offset": float(rng.normal() * 0.1),
+            }
+        )
+    return recs
+
+
+def test_avro_round_trip(tmp_path):
+    recs = _mk_records()
+    p = str(tmp_path / "t.avro")
+    for codec in ("null", "deflate"):
+        write_avro_file(p, TRAINING_EXAMPLE_AVRO, recs, codec=codec)
+        schema, back = read_avro_file(p)
+        assert back == recs
+        assert schema["name"] == "TrainingExampleAvro"
+
+
+@pytest.mark.skipif(
+    not os.path.isdir(REFERENCE_FIXTURES), reason="reference fixtures not mounted"
+)
+def test_avro_reads_reference_fixtures():
+    p = os.path.join(REFERENCE_FIXTURES, "DriverIntegTest/input/heart.avro")
+    schema, recs = read_avro_file(p)
+    assert len(recs) == 250
+    assert {f["name"] for f in schema["fields"]} >= {"label", "features"}
+    labels = {r["label"] for r in recs}
+    assert labels <= {-1, 1, -1.0, 1.0, 0.0, 0}
+
+    # GAME fixture with multiple feature bags + id columns
+    p2 = os.path.join(
+        REFERENCE_FIXTURES, "GameIntegTest/input/duplicateFeatures/yahoo-music-train.avro"
+    )
+    schema2, recs2 = read_avro_file(p2)
+    assert {"userId", "songId", "features", "userFeatures", "songFeatures"} <= {
+        f["name"] for f in schema2["fields"]
+    }
+    assert len(recs2) > 0
+
+
+def test_index_map_round_trip(tmp_path):
+    im = IndexMap.from_name_terms([("a", ""), ("b", "x"), ("c", "")])
+    assert im.intercept_index == len(im) - 1
+    assert im.get_index(feature_key("b", "x")) >= 0
+    assert im.get_index("nope") == -1
+    p = str(tmp_path / "idx.bin")
+    im.save(p)
+    im2 = IndexMap.load(p)
+    assert dict(im.items()) == dict(im2.items())
+
+
+def test_read_avro_dataset(tmp_path):
+    recs = _mk_records()
+    p = str(tmp_path / "train.avro")
+    write_avro_file(p, TRAINING_EXAMPLE_AVRO, recs)
+    shard_cfg = {"global": FeatureShardConfig(feature_bags=("features",))}
+    ds, imaps = read_avro_dataset(p, shard_cfg, id_tag_columns=["userId"])
+    assert ds.n_rows == 25
+    assert ds.shard_dims["global"] == len(imaps["global"])
+    assert imaps["global"].intercept_index is not None
+    # every row got an intercept entry
+    rows, cols, vals = ds.shard_coo["global"]
+    icol = imaps["global"].intercept_index
+    assert np.sum(cols == icol) == 25
+    assert set(ds.id_tags["userId"]) == {f"u{i}" for i in range(5)}
+    # batch conversion
+    batch = ds.to_batch("global", dtype=jnp.float64)
+    assert batch.n_rows == 25
+    np.testing.assert_allclose(np.asarray(batch.labels), ds.labels)
+
+
+def test_read_libsvm(tmp_path):
+    p = str(tmp_path / "data.libsvm")
+    with open(p, "w") as f:
+        f.write("+1 1:0.5 3:1.5\n-1 2:2.0\n+1 1:1.0 2:1.0 3:1.0\n")
+    ds = read_libsvm(p)
+    assert ds.n_rows == 3
+    np.testing.assert_allclose(ds.labels, [1, 0, 1])
+    batch = ds.to_batch("global", dtype=jnp.float64)
+    x = np.asarray(batch.features.to_dense())
+    # cols 1..3 populated, last col is intercept
+    np.testing.assert_allclose(x[0], [0, 0.5, 0, 1.5, 1.0])
+
+
+def test_glm_save_load(tmp_path):
+    im = IndexMap.from_name_terms([("f0", ""), ("f1", "t")])
+    means = jnp.asarray([0.5, -1.5, 2.0], jnp.float64)
+    model = LogisticRegressionModel(Coefficients(means=means))
+    p = str(tmp_path / "model" / "part-00000.avro")
+    save_glm(p, model, im, model_id="m1")
+    back = load_glm(p, im)
+    assert isinstance(back, LogisticRegressionModel)
+    np.testing.assert_allclose(np.asarray(back.coefficients.means), np.asarray(means))
+
+
+def test_game_model_save_load(tmp_path):
+    im_f = IndexMap.from_name_terms([("g0", ""), ("g1", "")])
+    im_u = IndexMap.from_name_terms([("u0", ""), ("u1", "")])
+    fe = FixedEffectModel(
+        model=LogisticRegressionModel(Coefficients(jnp.asarray([1.0, -2.0, 0.5], jnp.float64))),
+        feature_shard="globalShard",
+    )
+    re = RandomEffectModel(
+        random_effect_type="userId",
+        feature_shard="userShard",
+        task="logistic_regression",
+        entity_ids=np.asarray(["uA", "uB"], dtype=object),
+        coef_indices=jnp.asarray([[0, 2], [1, -1]], jnp.int32),
+        coef_values=jnp.asarray([[0.3, -0.7], [1.1, 0.0]], jnp.float64),
+    )
+    gm = GameModel(models={"global": fe, "per-user": re}, task="logistic_regression")
+    d = str(tmp_path / "gameModel")
+    imaps = {"globalShard": im_f, "userShard": im_u}
+    save_game_model(d, gm, imaps)
+
+    assert os.path.exists(os.path.join(d, "model-metadata.json"))
+    assert open(os.path.join(d, "fixed-effect", "global", "id-info")).read().strip() == "globalShard"
+
+    back = load_game_model(d, imaps)
+    assert set(back.coordinates()) == {"global", "per-user"}
+    np.testing.assert_allclose(
+        np.asarray(back["global"].model.coefficients.means), [1.0, -2.0, 0.5]
+    )
+    re2 = back["per-user"]
+    assert re2.random_effect_type == "userId"
+    dense = re2.dense_coefficients(3)
+    exp = np.zeros((2, 3))
+    exp[0, 0], exp[0, 2] = 0.3, -0.7
+    exp[1, 1] = 1.1
+    rows = re2.rows_for(["uA", "uB"])
+    np.testing.assert_allclose(dense[rows], exp)
+    assert re2.entity_row("unseen") == -1
+
+
+def test_random_effect_scoring_unseen_entity():
+    re = RandomEffectModel(
+        random_effect_type="userId",
+        feature_shard="s",
+        task="linear_regression",
+        entity_ids=np.asarray(["a"], dtype=object),
+        coef_indices=jnp.asarray([[0, 3]], jnp.int32),
+        coef_values=jnp.asarray([[2.0, 10.0]], jnp.float64),
+    )
+    # two rows: entity a, and unseen (-1)
+    rows = jnp.asarray([0, -1])
+    fi = jnp.asarray([[0, 3], [0, 3]], jnp.int32)
+    fv = jnp.asarray([[1.0, 0.5], [1.0, 0.5]], jnp.float64)
+    s = np.asarray(re.score_ell_rows(rows, fi, fv))
+    np.testing.assert_allclose(s, [2.0 + 5.0, 0.0])
