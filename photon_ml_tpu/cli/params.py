@@ -1,0 +1,115 @@
+"""CLI parameter grammar shared by the drivers.
+
+Reference: photon-client io/scopt/** — the scopt parsers map typed CLI args to
+driver params, with a rich comma/pipe grammar for nested configs, e.g.
+``--coordinate-configurations name=global,feature.shard=...,optimizer=LBFGS,
+reg.weights=0.1|1|10`` (README.md:297, ScoptParserHelpers.scala). This module
+re-creates that grammar on argparse.
+"""
+
+from __future__ import annotations
+
+import argparse
+from typing import Dict, List, Optional, Sequence
+
+from ..game.problem import GLMOptimizationConfig
+from ..io.data import FeatureShardConfig
+from ..ops.regularization import RegularizationContext
+from ..optimize import OptimizerConfig, OptimizerType
+from ..estimators.game_estimator import CoordinateConfig
+
+
+def parse_kv(spec: str) -> Dict[str, str]:
+    out: Dict[str, str] = {}
+    for part in spec.split(","):
+        part = part.strip()
+        if not part:
+            continue
+        k, eq, v = part.partition("=")
+        if not eq:
+            raise ValueError(f"expected key=value in {spec!r}, got {part!r}")
+        out[k.strip()] = v.strip()
+    return out
+
+
+def parse_feature_shard(spec: str) -> Dict[str, FeatureShardConfig]:
+    """``name=globalShard,bags=features|userFeatures,intercept=true``"""
+    kv = parse_kv(spec)
+    name = kv.pop("name")
+    bags = tuple(kv.pop("bags").split("|"))
+    intercept = kv.pop("intercept", "true").lower() in ("true", "1", "yes")
+    if kv:
+        raise ValueError(f"unknown feature-shard keys: {sorted(kv)}")
+    return {name: FeatureShardConfig(feature_bags=bags, has_intercept=intercept)}
+
+
+def parse_coordinate(spec: str) -> CoordinateConfig:
+    """``name=global,shard=globalShard[,re.type=userId],optimizer=LBFGS,
+    tolerance=1e-7,max.iter=100,reg.type=L2,reg.alpha=0.5,reg.weights=0.1|1|10,
+    down.sampling.rate=1.0,active.cap=256,active.lower.bound=1,variance=NONE``"""
+    kv = parse_kv(spec)
+    name = kv.pop("name")
+    shard = kv.pop("shard")
+    re_type = kv.pop("re.type", None)
+    opt = OptimizerConfig(
+        optimizer_type=OptimizerType(kv.pop("optimizer", "LBFGS").upper()),
+        tolerance=float(kv.pop("tolerance", 1e-7)),
+        max_iterations=int(kv.pop("max.iter", 100)),
+        num_corrections=int(kv.pop("num.corrections", 10)),
+    )
+    reg = RegularizationContext(
+        reg_type=kv.pop("reg.type", "NONE"),
+        elastic_net_alpha=float(kv.pop("reg.alpha", 1.0)),
+    )
+    weights = tuple(float(w) for w in kv.pop("reg.weights", "0").split("|"))
+    cfg = GLMOptimizationConfig(
+        optimizer=opt,
+        regularization=reg,
+        reg_weight=weights[0],
+        down_sampling_rate=float(kv.pop("down.sampling.rate", 1.0)),
+        variance_type=kv.pop("variance", "NONE").upper(),
+    )
+    cc = CoordinateConfig(
+        name=name,
+        feature_shard=shard,
+        config=cfg,
+        random_effect_type=re_type,
+        reg_weights=weights,
+        active_cap=int(kv["active.cap"]) if "active.cap" in kv else None,
+        active_lower_bound=int(kv.pop("active.lower.bound", 1)),
+    )
+    kv.pop("active.cap", None)
+    if kv:
+        raise ValueError(f"unknown coordinate keys: {sorted(kv)}")
+    return cc
+
+
+def add_common_io_args(p: argparse.ArgumentParser):
+    p.add_argument("--input-data", required=True, help="Avro file or directory")
+    p.add_argument(
+        "--feature-shard",
+        action="append",
+        default=[],
+        required=False,
+        help="name=SHARD,bags=BAG|BAG,intercept=true (repeatable)",
+    )
+    p.add_argument(
+        "--id-tags",
+        default="",
+        help="comma-separated id columns to extract (random-effect types)",
+    )
+    p.add_argument("--response-column", default="label")
+    p.add_argument(
+        "--feature-index-dir",
+        default=None,
+        help="directory of prebuilt index stores (FeatureIndexingDriver output)",
+    )
+
+
+def build_shard_configs(args) -> Dict[str, FeatureShardConfig]:
+    shards: Dict[str, FeatureShardConfig] = {}
+    for spec in args.feature_shard:
+        shards.update(parse_feature_shard(spec))
+    if not shards:
+        shards["global"] = FeatureShardConfig(feature_bags=("features",))
+    return shards

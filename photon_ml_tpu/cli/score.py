@@ -1,0 +1,106 @@
+"""GAME scoring driver.
+
+Reference: photon-client .../cli/game/scoring/GameScoringDriver.scala:25-284
+(§3.2): read data -> load GAME model -> GameTransformer.transform -> optional
+evaluation -> write ScoringResultAvro records.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import logging
+import os
+import sys
+from typing import List, Optional
+
+import numpy as np
+
+from ..estimators.game_estimator import GameTransformer
+from ..io import read_avro_dataset
+from ..io.avro import write_avro_file
+from ..io.index_map import load_partitioned
+from ..io.model_io import load_game_model
+from ..io.schemas import SCORING_RESULT_AVRO
+from ..utils.logging import setup_logging
+from .params import add_common_io_args, build_shard_configs
+
+logger = logging.getLogger("photon_ml_tpu")
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser("photon-ml-tpu game scoring driver")
+    add_common_io_args(p)
+    p.add_argument("--model-input-dir", required=True)
+    p.add_argument("--output-dir", required=True)
+    p.add_argument("--task", default=None, help="override model task type")
+    p.add_argument("--evaluators", default="")
+    p.add_argument("--model-id", default="", help="modelId stamped on score records")
+    p.add_argument("--log-file", default=None)
+    p.add_argument("--log-level", default="INFO")
+    return p
+
+
+def run(argv: Optional[List[str]] = None):
+    args = build_parser().parse_args(argv)
+    setup_logging(args.log_level, args.log_file)
+
+    shards = build_shard_configs(args)
+    id_tags = [t for t in args.id_tags.split(",") if t]
+
+    index_maps = None
+    if args.feature_index_dir:
+        index_maps = {s: load_partitioned(args.feature_index_dir, s) for s in shards}
+    raw, index_maps = read_avro_dataset(
+        args.input_data,
+        shards,
+        index_maps=index_maps,
+        id_tag_columns=id_tags,
+        response_column=args.response_column,
+    )
+    model = load_game_model(args.model_input_dir, index_maps, task=args.task)
+    # random-effect types must be available as id tags
+    missing = [
+        m.random_effect_type
+        for m in model.models.values()
+        if hasattr(m, "random_effect_type") and m.random_effect_type not in raw.id_tags
+    ]
+    if missing:
+        raise SystemExit(
+            f"model needs id tags {missing}; pass --id-tags {','.join(missing)}"
+        )
+
+    transformer = GameTransformer(model=model)
+    evaluators = [e for e in args.evaluators.split(",") if e]
+    scores, evaluation = transformer.transform(raw, evaluator_specs=evaluators)
+
+    os.makedirs(args.output_dir, exist_ok=True)
+
+    def records():
+        for i in range(raw.n_rows):
+            yield {
+                "uid": None if raw.uids is None or raw.uids[i] is None else str(raw.uids[i]),
+                "label": float(raw.labels[i]),
+                "modelId": args.model_id,
+                "predictionScore": float(scores[i]),
+                "weight": float(raw.weights[i]),
+                "metadataMap": None,
+            }
+
+    write_avro_file(
+        os.path.join(args.output_dir, "scores.avro"), SCORING_RESULT_AVRO, records()
+    )
+    if evaluation is not None:
+        with open(os.path.join(args.output_dir, "evaluation.json"), "w") as f:
+            json.dump(evaluation.metrics, f, indent=2, default=float)
+        logger.info("evaluation: %s", evaluation.metrics)
+    logger.info("wrote %d scores to %s", raw.n_rows, args.output_dir)
+    return scores, evaluation
+
+
+def main():
+    run(sys.argv[1:])
+
+
+if __name__ == "__main__":
+    main()

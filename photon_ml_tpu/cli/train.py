@@ -1,0 +1,268 @@
+"""GAME training driver.
+
+Reference: photon-client .../cli/game/training/GameTrainingDriver.scala:54-854
+(§3.1 call stack): read+index data -> validate -> normalization -> expand
+optimization configs -> GameEstimator.fit -> model selection (output mode
+ALL/BEST/TUNED) -> optional GP hyperparameter tuning -> save models.
+
+Usage:
+  python -m photon_ml_tpu.cli.train \\
+    --input-data train.avro --validation-data val.avro \\
+    --task logistic_regression \\
+    --feature-shard name=globalShard,bags=features \\
+    --feature-shard name=userShard,bags=userFeatures \\
+    --coordinate name=global,shard=globalShard,optimizer=TRON,reg.type=L2,reg.weights=1|10 \\
+    --coordinate name=per-user,shard=userShard,re.type=userId,reg.type=L2,reg.weights=1 \\
+    --evaluators AUC,LOGISTIC_LOSS --output-dir out/
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import logging
+import os
+import sys
+from typing import Dict, List, Optional
+
+import numpy as np
+
+from ..estimators.game_estimator import GameEstimator, GameResult
+from ..io import read_avro_dataset, save_game_model
+from ..io.index_map import IndexMap
+from ..io.model_io import load_game_model
+from ..ops.normalization import build_normalization
+from ..tuning.rescaling import HyperparameterConfig, ParamRange
+from ..tuning.tuner import get_tuner
+from ..utils.logging import setup_logging
+from ..utils.stats import compute_feature_statistics, save_feature_statistics
+from .params import add_common_io_args, build_shard_configs, parse_coordinate
+
+logger = logging.getLogger("photon_ml_tpu")
+
+OUTPUT_MODE_ALL = "ALL"
+OUTPUT_MODE_BEST = "BEST"
+OUTPUT_MODE_TUNED = "TUNED"
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser("photon-ml-tpu game training driver")
+    add_common_io_args(p)
+    p.add_argument("--validation-data", default=None)
+    p.add_argument("--task", default="logistic_regression")
+    p.add_argument(
+        "--coordinate",
+        action="append",
+        default=[],
+        required=False,
+        help="coordinate configuration spec (repeatable, ordered)",
+    )
+    p.add_argument("--coordinate-descent-iterations", type=int, default=1)
+    p.add_argument("--evaluators", default="", help="comma-separated evaluator specs")
+    p.add_argument("--output-dir", required=True)
+    p.add_argument(
+        "--output-mode",
+        default=OUTPUT_MODE_BEST,
+        choices=[OUTPUT_MODE_ALL, OUTPUT_MODE_BEST, OUTPUT_MODE_TUNED],
+    )
+    p.add_argument("--model-input-dir", default=None, help="warm-start GAME model")
+    p.add_argument(
+        "--partial-retrain-locked",
+        default="",
+        help="comma-separated coordinate names to lock (requires --model-input-dir)",
+    )
+    p.add_argument(
+        "--normalization",
+        default="NONE",
+        choices=["NONE", "STANDARDIZATION", "SCALE_WITH_STANDARD_DEVIATION", "SCALE_WITH_MAX_MAGNITUDE"],
+    )
+    p.add_argument("--model-sparsity-threshold", type=float, default=0.0)
+    p.add_argument("--compute-feature-stats", action="store_true")
+    p.add_argument(
+        "--hyper-parameter-tuning",
+        default="NONE",
+        choices=["NONE", "RANDOM", "BAYESIAN"],
+    )
+    p.add_argument("--hyper-parameter-tuning-iter", type=int, default=10)
+    p.add_argument("--log-file", default=None)
+    p.add_argument("--log-level", default="INFO")
+    return p
+
+
+def run(argv: Optional[List[str]] = None) -> Dict:
+    args = build_parser().parse_args(argv)
+    setup_logging(args.log_level, args.log_file)
+
+    shards = build_shard_configs(args)
+    id_tags = [t for t in args.id_tags.split(",") if t]
+    coord_specs = args.coordinate or [
+        "name=global,shard=global,optimizer=LBFGS,reg.type=L2,reg.weights=1"
+    ]
+    coords = [parse_coordinate(s) for s in coord_specs]
+    for cc in coords:
+        if cc.is_random_effect and cc.random_effect_type not in id_tags:
+            id_tags.append(cc.random_effect_type)
+
+    logger.info("reading training data from %s", args.input_data)
+    index_maps = None
+    if args.feature_index_dir:
+        from ..io.index_map import load_partitioned
+
+        index_maps = {s: load_partitioned(args.feature_index_dir, s) for s in shards}
+    raw, index_maps = read_avro_dataset(
+        args.input_data,
+        shards,
+        index_maps=index_maps,
+        id_tag_columns=id_tags,
+        response_column=args.response_column,
+    )
+    logger.info("training rows: %d; shard dims: %s", raw.n_rows, raw.shard_dims)
+
+    validation = None
+    if args.validation_data:
+        validation, _ = read_avro_dataset(
+            args.validation_data,
+            shards,
+            index_maps=index_maps,
+            id_tag_columns=id_tags,
+            response_column=args.response_column,
+        )
+
+    # normalization from feature statistics (GameTrainingDriver:555-571)
+    if args.normalization != "NONE":
+        for cc in coords:
+            if not cc.is_random_effect:
+                stats = compute_feature_statistics(raw, cc.feature_shard)
+                cc.normalization = build_normalization(
+                    args.normalization,
+                    stats["mean"],
+                    stats["variance"],
+                    stats["max_magnitude"],
+                    intercept_index=index_maps[cc.feature_shard].intercept_index,
+                )
+
+    if args.compute_feature_stats:
+        os.makedirs(args.output_dir, exist_ok=True)
+        for shard in shards:
+            save_feature_statistics(
+                os.path.join(args.output_dir, f"feature-stats-{shard}.avro"),
+                compute_feature_statistics(raw, shard),
+                index_maps[shard],
+            )
+
+    initial_model = None
+    if args.model_input_dir:
+        initial_model = load_game_model(args.model_input_dir, index_maps, task=args.task)
+
+    evaluators = [e for e in args.evaluators.split(",") if e]
+    estimator = GameEstimator(
+        task=args.task,
+        coordinate_configs=coords,
+        n_cd_iterations=args.coordinate_descent_iterations,
+        evaluator_specs=evaluators,
+        partial_retrain_locked=[
+            c for c in args.partial_retrain_locked.split(",") if c
+        ],
+    )
+    results = estimator.fit(raw, validation=validation, initial_model=initial_model)
+
+    # optional hyperparameter auto-tuning (GameTrainingDriver:642-673)
+    tuned_results: List[GameResult] = []
+    if args.hyper_parameter_tuning != "NONE" and validation is not None:
+        tuned_results = _run_tuning(args, estimator, raw, validation, coords, results)
+
+    all_results = list(results) + tuned_results
+    best = estimator.select_best(all_results)
+
+    os.makedirs(args.output_dir, exist_ok=True)
+    summary = {
+        "task": args.task,
+        "configs": [
+            {
+                "reg_weights": r.config,
+                "metrics": None if r.evaluation is None else r.evaluation.metrics,
+            }
+            for r in all_results
+        ],
+        "best": {
+            "reg_weights": best.config,
+            "metrics": None if best.evaluation is None else best.evaluation.metrics,
+        },
+    }
+    with open(os.path.join(args.output_dir, "training-summary.json"), "w") as f:
+        json.dump(summary, f, indent=2, default=float)
+
+    to_save = all_results if args.output_mode == OUTPUT_MODE_ALL else [best]
+    for i, r in enumerate(to_save):
+        name = "best" if r is best and args.output_mode != OUTPUT_MODE_ALL else f"model-{i}"
+        save_game_model(
+            os.path.join(args.output_dir, "models", name),
+            r.model,
+            index_maps,
+            metadata={"regWeights": r.config},
+            sparsity_threshold=args.model_sparsity_threshold,
+        )
+    logger.info("saved %d model(s) to %s", len(to_save), args.output_dir)
+    return summary
+
+
+def _run_tuning(args, estimator, raw, validation, coords, prior_results):
+    """GP/random tuning over per-coordinate log10 reg weights
+    (GameEstimatorEvaluationFunction semantics: candidate <-> (log lambda,...))."""
+    tunable = [cc.name for cc in coords if cc.name not in estimator.partial_retrain_locked]
+    hp = HyperparameterConfig(
+        params=[
+            ParamRange(name=f"{n}.reg_weight", min=1e-4, max=1e4, transform="LOG")
+            for n in tunable
+        ]
+    )
+    higher_better = _higher_is_better(args.evaluators)
+    results: List[GameResult] = []
+
+    def evaluate(unit_vec):
+        native = hp.scale_up(unit_vec)
+        weights = dict(zip(tunable, native))
+        import dataclasses as dc
+
+        cfgs = []
+        for cc in coords:
+            w = weights.get(cc.name, cc.config.reg_weight)
+            cfgs.append(dc.replace(cc, reg_weights=(w,)))
+        est = GameEstimator(
+            task=args.task,
+            coordinate_configs=cfgs,
+            n_cd_iterations=args.coordinate_descent_iterations,
+            evaluator_specs=[e for e in args.evaluators.split(",") if e],
+            partial_retrain_locked=list(estimator.partial_retrain_locked),
+        )
+        r = est.fit(raw, validation=validation)[0]
+        results.append(r)
+        metric = r.evaluation.primary_metric
+        # the tuner minimizes; negate higher-is-better metrics
+        return (-metric if higher_better else metric), r
+
+    tuner = get_tuner(args.hyper_parameter_tuning)
+    tuner.search(
+        args.hyper_parameter_tuning_iter,
+        hp.dim,
+        evaluate,
+        seed=0,
+    )
+    return results
+
+
+def _higher_is_better(evaluators: str) -> bool:
+    from ..evaluation.evaluators import build_evaluator
+
+    specs = [e for e in evaluators.split(",") if e]
+    if not specs:
+        return False
+    return build_evaluator(specs[0]).higher_is_better
+
+
+def main():
+    run(sys.argv[1:])
+
+
+if __name__ == "__main__":
+    main()

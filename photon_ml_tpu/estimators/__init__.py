@@ -1,3 +1,17 @@
+from .game_estimator import (
+    CoordinateConfig,
+    GameEstimator,
+    GameResult,
+    GameTransformer,
+)
 from .model_training import TrainedModel, select_best_model, train_glm_grid
 
-__all__ = ["TrainedModel", "train_glm_grid", "select_best_model"]
+__all__ = [
+    "CoordinateConfig",
+    "GameEstimator",
+    "GameResult",
+    "GameTransformer",
+    "TrainedModel",
+    "train_glm_grid",
+    "select_best_model",
+]

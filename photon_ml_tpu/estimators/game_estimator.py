@@ -1,0 +1,287 @@
+"""GameEstimator: the fit() API over GAME coordinate configurations.
+
+Reference: photon-api .../estimators/GameEstimator.scala:53-705 —
+fit(data, validationData, optimizationConfigurations) prepares per-coordinate
+datasets once, builds the validation evaluation suite, then runs coordinate
+descent once per optimization configuration, warm-starting each run from the
+previous configuration's model (:356-374), returning one GameResult per
+configuration. Regularization-weight grids expand as a cartesian product over
+coordinates (GameTrainingDriver.prepareGameOptConfigs:623-632).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import itertools
+import logging
+from typing import Dict, List, Mapping, Optional, Sequence, Tuple
+
+import jax.numpy as jnp
+import numpy as np
+
+from ..evaluation.suite import EvaluationResults, build_suite
+from ..game.coordinate import (
+    Coordinate,
+    FixedEffectCoordinate,
+    ModelCoordinate,
+    RandomEffectCoordinate,
+)
+from ..game.data import (
+    build_fixed_effect_dataset,
+    build_random_effect_dataset,
+)
+from ..game.descent import CoordinateDescent, ValidationContext
+from ..game.problem import GLMOptimizationConfig
+from ..io.data import RawDataset
+from ..models.game import FixedEffectModel, GameModel, RandomEffectModel
+from ..ops.normalization import NormalizationContext
+from ..utils.timed import timed
+
+logger = logging.getLogger("photon_ml_tpu")
+
+
+@dataclasses.dataclass
+class CoordinateConfig:
+    """One coordinate's dataset + optimization definition (the reference's
+    CoordinateConfiguration: dataset config + optimization config + reg grid)."""
+
+    name: str
+    feature_shard: str
+    config: GLMOptimizationConfig
+    random_effect_type: Optional[str] = None  # None => fixed effect
+    reg_weights: Sequence[float] = ()  # grid; empty -> [config.reg_weight]
+    active_cap: Optional[int] = None
+    active_lower_bound: int = 1
+    normalization: Optional[NormalizationContext] = None
+
+    @property
+    def is_random_effect(self) -> bool:
+        return self.random_effect_type is not None
+
+    def grid(self) -> Sequence[float]:
+        return tuple(self.reg_weights) or (self.config.reg_weight,)
+
+
+@dataclasses.dataclass
+class GameResult:
+    model: GameModel
+    config: Dict[str, float]  # coordinate -> reg weight
+    evaluation: Optional[EvaluationResults]
+    trackers: Dict[str, object]
+
+
+class GameEstimator:
+    def __init__(
+        self,
+        task: str,
+        coordinate_configs: Sequence[CoordinateConfig],
+        n_cd_iterations: int = 1,
+        evaluator_specs: Sequence[str] = (),
+        dtype=jnp.float32,
+        partial_retrain_locked: Sequence[str] = (),
+        entity_pad_multiple: int = 1,
+    ):
+        if not coordinate_configs:
+            raise ValueError("need at least one coordinate configuration")
+        names = [c.name for c in coordinate_configs]
+        if len(set(names)) != len(names):
+            raise ValueError(f"duplicate coordinate names: {names}")
+        self.task = task
+        self.coordinate_configs = list(coordinate_configs)
+        self.n_cd_iterations = n_cd_iterations
+        self.evaluator_specs = list(evaluator_specs)
+        self.dtype = dtype
+        self.partial_retrain_locked = set(partial_retrain_locked)
+        self.entity_pad_multiple = entity_pad_multiple
+        unknown = self.partial_retrain_locked - set(names)
+        if unknown:
+            raise ValueError(f"locked coordinates not in configs: {sorted(unknown)}")
+
+    # -- dataset preparation -------------------------------------------------
+
+    def _prepare_datasets(self, raw: RawDataset):
+        datasets = {}
+        for cc in self.coordinate_configs:
+            with timed(f"prepare dataset {cc.name}"):
+                if cc.is_random_effect:
+                    datasets[cc.name] = build_random_effect_dataset(
+                        raw,
+                        cc.name,
+                        cc.feature_shard,
+                        cc.random_effect_type,
+                        active_cap=cc.active_cap,
+                        active_lower_bound=cc.active_lower_bound,
+                        dtype=self.dtype,
+                        pad_entities_to_multiple=self.entity_pad_multiple,
+                    )
+                else:
+                    datasets[cc.name] = build_fixed_effect_dataset(
+                        raw, cc.name, cc.feature_shard, dtype=self.dtype
+                    )
+        return datasets
+
+    def _validation_context(
+        self, val_raw: RawDataset
+    ) -> Tuple[ValidationContext, Dict[str, object]]:
+        suite = build_suite(
+            self.evaluator_specs or ["RMSE"],
+            val_raw.labels,
+            val_raw.weights,
+            id_tags=val_raw.id_tags,
+        )
+        # per-coordinate validation scoring closures
+        from ..game.data import _rows_to_ell  # host helper
+
+        score_fns = {}
+        for cc in self.coordinate_configs:
+            rows, cols, vals = val_raw.shard_coo[cc.feature_shard]
+            if cc.is_random_effect:
+                idx, val = _rows_to_ell(rows, cols, vals, val_raw.n_rows)
+                ids = val_raw.id_tags[cc.random_effect_type]
+                idx_j = jnp.asarray(idx)
+                val_j = jnp.asarray(val, self.dtype)
+
+                def fn(model, _ids=ids, _idx=idx_j, _val=val_j):
+                    erow = jnp.asarray(model.rows_for(_ids).astype(np.int32))
+                    return model.score_ell_rows(erow, _idx, _val)
+
+            else:
+                batch = val_raw.to_batch(cc.feature_shard, dtype=self.dtype)
+
+                def fn(model, _batch=batch):
+                    return _batch.features.matvec(model.model.coefficients.means)
+
+            score_fns[cc.name] = fn
+        return (
+            ValidationContext(suite=suite, score_fns=score_fns, offsets=val_raw.offsets),
+            score_fns,
+        )
+
+    def _make_coordinates(
+        self,
+        datasets,
+        reg_weights: Mapping[str, float],
+        initial_models: Mapping[str, object],
+    ) -> Dict[str, Coordinate]:
+        coords: Dict[str, Coordinate] = {}
+        for cc in self.coordinate_configs:
+            cfg = cc.config.with_reg_weight(reg_weights[cc.name])
+            if cc.is_random_effect:
+                inner: Coordinate = RandomEffectCoordinate(
+                    dataset=datasets[cc.name], task=self.task, config=cfg
+                )
+            else:
+                inner = FixedEffectCoordinate(
+                    dataset=datasets[cc.name],
+                    task=self.task,
+                    config=cfg,
+                    normalization=cc.normalization,
+                )
+            if cc.name in self.partial_retrain_locked:
+                locked = initial_models.get(cc.name)
+                if locked is None:
+                    raise ValueError(
+                        f"locked coordinate {cc.name} needs a pretrained model"
+                    )
+                coords[cc.name] = ModelCoordinate(inner=inner, locked_model=locked)
+            else:
+                coords[cc.name] = inner
+        return coords
+
+    # -- fit -------------------------------------------------------------------
+
+    def fit(
+        self,
+        raw: RawDataset,
+        validation: Optional[RawDataset] = None,
+        initial_model: Optional[GameModel] = None,
+    ) -> List[GameResult]:
+        datasets = self._prepare_datasets(raw)
+        validation_ctx = None
+        if validation is not None:
+            # evaluator_specs default to RMSE inside _validation_context
+            validation_ctx, _ = self._validation_context(validation)
+
+        # cartesian product of per-coordinate reg-weight grids
+        grids = [cc.grid() for cc in self.coordinate_configs]
+        names = [cc.name for cc in self.coordinate_configs]
+        results: List[GameResult] = []
+        prev_models: Dict[str, object] = dict(
+            (initial_model.models if initial_model else {})
+        )
+        for combo in itertools.product(*grids):
+            reg_weights = dict(zip(names, combo))
+            coords = self._make_coordinates(datasets, reg_weights, prev_models)
+            cd = CoordinateDescent(
+                coords, n_iterations=self.n_cd_iterations, validation=validation_ctx
+            )
+            with timed(f"train config {reg_weights}", logging.INFO):
+                out = cd.run(initial_models=prev_models)
+            results.append(
+                GameResult(
+                    model=out.model,
+                    config=reg_weights,
+                    evaluation=out.best_evaluation,
+                    trackers=out.trackers,
+                )
+            )
+            # warm start next config from this one (GameEstimator.scala:356-374)
+            prev_models = dict(out.model.models)
+        return results
+
+    def select_best(self, results: Sequence[GameResult]) -> GameResult:
+        """Best result by primary validation metric (falls back to the last)."""
+        with_eval = [r for r in results if r.evaluation is not None]
+        if not with_eval:
+            return results[-1]
+        suite_primary = build_suite(
+            self.evaluator_specs or ["RMSE"], np.zeros(1)
+        ).primary
+        best = with_eval[0]
+        for r in with_eval[1:]:
+            if suite_primary.better(
+                r.evaluation.primary_metric, best.evaluation.primary_metric
+            ):
+                best = r
+        return best
+
+
+@dataclasses.dataclass
+class GameTransformer:
+    """Scoring twin of the estimator (GameTransformer.scala:39-318):
+    model + dataset -> summed per-coordinate scores (+offsets), optional eval."""
+
+    model: GameModel
+    dtype: object = jnp.float32
+
+    def transform(
+        self, raw: RawDataset, evaluator_specs: Sequence[str] = ()
+    ) -> Tuple[np.ndarray, Optional[EvaluationResults]]:
+        from ..game.data import _rows_to_ell
+
+        total = np.asarray(raw.offsets, dtype=np.float64).copy()
+        for name, sub in self.model.models.items():
+            if isinstance(sub, FixedEffectModel):
+                batch = raw.to_batch(sub.feature_shard, dtype=self.dtype)
+                total += np.asarray(
+                    batch.features.matvec(sub.model.coefficients.means), dtype=np.float64
+                )
+            elif isinstance(sub, RandomEffectModel):
+                rows, cols, vals = raw.shard_coo[sub.feature_shard]
+                idx, val = _rows_to_ell(rows, cols, vals, raw.n_rows)
+                ids = raw.id_tags[sub.random_effect_type]
+                erow = jnp.asarray(sub.rows_for(ids).astype(np.int32))
+                total += np.asarray(
+                    sub.score_ell_rows(erow, jnp.asarray(idx), jnp.asarray(val, self.dtype)),
+                    dtype=np.float64,
+                )
+            else:
+                raise TypeError(f"unknown model type for {name}: {type(sub)}")
+
+        evaluation = None
+        if evaluator_specs:
+            suite = build_suite(
+                evaluator_specs, raw.labels, raw.weights, id_tags=raw.id_tags
+            )
+            evaluation = suite.evaluate(total)
+        return total, evaluation

@@ -1,0 +1,34 @@
+from .coordinate import (
+    Coordinate,
+    FixedEffectCoordinate,
+    ModelCoordinate,
+    RandomEffectCoordinate,
+)
+from .data import (
+    EntityBlocks,
+    FixedEffectDataset,
+    RandomEffectDataset,
+    build_fixed_effect_dataset,
+    build_random_effect_dataset,
+)
+from .descent import CoordinateDescent, CoordinateDescentResult, ValidationContext
+from .problem import GLMOptimizationConfig, GLMProblem
+from .sampling import down_sample
+
+__all__ = [
+    "Coordinate",
+    "FixedEffectCoordinate",
+    "RandomEffectCoordinate",
+    "ModelCoordinate",
+    "FixedEffectDataset",
+    "RandomEffectDataset",
+    "EntityBlocks",
+    "build_fixed_effect_dataset",
+    "build_random_effect_dataset",
+    "CoordinateDescent",
+    "CoordinateDescentResult",
+    "ValidationContext",
+    "GLMOptimizationConfig",
+    "GLMProblem",
+    "down_sample",
+]

@@ -1,0 +1,282 @@
+"""GAME datasets: fixed-effect batches and entity-blocked random-effect data.
+
+Reference: photon-api .../data/ — FixedEffectDataset.scala,
+RandomEffectDataset.scala:51-600 (build pipeline: key-by-entity -> subspace
+projectors -> project -> reservoir-cap -> passive split), LocalDataset.scala,
+RandomEffectDatasetPartitioner.scala (entity sharding), and the
+LinearSubspaceProjector (photon-api .../projector/LinearSubspaceProjector.scala:37-90).
+
+TPU re-design (SURVEY.md §7.3): instead of an RDD of per-entity iterables,
+a random-effect dataset is a set of *dense entity blocks*:
+
+    features  f[E, K, S]   per-entity rows projected into the entity's
+    labels    f[E, K]      feature subspace (S = max subspace dim,
+    weights   f[E, K]      K = max (capped) rows per entity; zero-padded)
+    offsets   f[E, K]
+    proj_cols i32[E, S]    local dim -> global feature column (-1 pad)
+    active_rows i32[E, K]  global sample row of each block cell (-1 pad)
+
+Per-entity local solves then become one vmapped masked solver call — the
+MXU-friendly replacement for the reference's per-entity sequential L-BFGS
+fan-out (RandomEffectCoordinate.scala:273-329). Entity order doubles as the
+sharding axis: shard dim 0 over the mesh and each device owns a contiguous
+entity range (the bin-packing partitioner's role, P5).
+
+Active/passive split parity: entities with more than ``active_cap`` samples
+train on a deterministic hash-priority reservoir of ``active_cap`` rows with
+weights rescaled by count/cap (RandomEffectDataset.scala:403-506,
+MinHeapWithFixedCapacity semantics); the remaining *passive* rows are scored
+but never trained on. Entities with fewer than ``active_lower_bound`` samples
+are dropped from training entirely (scored as zeros until some other
+coordinate explains them).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Dict, List, Mapping, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..ops.features import FeatureMatrix, LabeledBatch
+from ..io.data import RawDataset
+
+Array = jax.Array
+
+
+@dataclasses.dataclass(frozen=True)
+class FixedEffectDataset:
+    """All samples' features from one shard (FixedEffectDataset.scala:26-152)."""
+
+    coordinate_id: str
+    feature_shard: str
+    batch: LabeledBatch
+
+    @property
+    def n_rows(self) -> int:
+        return self.batch.n_rows
+
+
+@jax.tree_util.register_dataclass
+@dataclasses.dataclass(frozen=True)
+class EntityBlocks:
+    """Device-side entity-blocked training data (see module docstring)."""
+
+    features: Array  # f[E, K, S]
+    labels: Array  # f[E, K]
+    offsets: Array  # f[E, K] (base offsets only; residuals added at train time)
+    weights: Array  # f[E, K]; 0 = padding
+    proj_cols: Array  # i32[E, S]; -1 = padding
+    active_rows: Array  # i32[E, K]; -1 = padding
+
+    @property
+    def num_entities(self) -> int:
+        return self.features.shape[0]
+
+    @property
+    def rows_per_entity(self) -> int:
+        return self.features.shape[1]
+
+    @property
+    def subspace_dim(self) -> int:
+        return self.features.shape[2]
+
+
+@dataclasses.dataclass(frozen=True)
+class RandomEffectDataset:
+    """Entity-blocked random-effect dataset + full-row scoring arrays."""
+
+    coordinate_id: str
+    feature_shard: str
+    random_effect_type: str
+    entity_ids: np.ndarray  # object[E], order = block row
+    blocks: EntityBlocks
+    # scoring representation for ALL rows of the full dataset (ELL, global space)
+    row_entity: Array  # i32[n] block row per sample, -1 = entity dropped/unseen
+    ell_idx: Array  # i32[n, F]
+    ell_val: Array  # f[n, F]
+    passive_rows: np.ndarray  # i64[*] rows not in any active block (info only)
+
+    @property
+    def num_entities(self) -> int:
+        return len(self.entity_ids)
+
+
+def _hash64(a: np.ndarray, seed: int) -> np.ndarray:
+    """Deterministic splitmix64-style mix of row ids (the reservoir priority;
+    plays the role of byteswap64(hash ^ uniqueId), RandomEffectDataset.scala:483-491)."""
+    x = (a.astype(np.uint64) + np.uint64(seed) * np.uint64(0x9E3779B97F4A7C15))
+    x = (x ^ (x >> np.uint64(30))) * np.uint64(0xBF58476D1CE4E5B9)
+    x = (x ^ (x >> np.uint64(27))) * np.uint64(0x94D049BB133111EB)
+    return x ^ (x >> np.uint64(31))
+
+
+def _rows_to_ell(
+    rows: np.ndarray, cols: np.ndarray, vals: np.ndarray, n: int
+) -> Tuple[np.ndarray, np.ndarray]:
+    """COO -> per-row padded (idx, val) with idx=0/val=0 padding. Vectorized."""
+    order = np.lexsort((cols, rows))
+    r, c, v = rows[order], cols[order], vals[order]
+    counts = np.bincount(r, minlength=n)
+    F = max(int(counts.max()) if n else 1, 1)
+    idx = np.zeros((n, F), dtype=np.int32)
+    val = np.zeros((n, F), dtype=np.float64)
+    if len(r):
+        starts = np.cumsum(np.concatenate([[0], counts[:-1]]))
+        within = np.arange(len(r)) - starts[r]
+        idx[r, within] = c
+        val[r, within] = v
+    return idx, val
+
+
+def build_fixed_effect_dataset(
+    raw: RawDataset,
+    coordinate_id: str,
+    feature_shard: str,
+    dtype=jnp.float32,
+    layout: str = "auto",
+) -> FixedEffectDataset:
+    return FixedEffectDataset(
+        coordinate_id=coordinate_id,
+        feature_shard=feature_shard,
+        batch=raw.to_batch(feature_shard, dtype=dtype, layout=layout),
+    )
+
+
+def build_random_effect_dataset(
+    raw: RawDataset,
+    coordinate_id: str,
+    feature_shard: str,
+    random_effect_type: str,
+    active_cap: Optional[int] = None,
+    active_lower_bound: int = 1,
+    seed: int = 0,
+    dtype=jnp.float32,
+    pad_entities_to_multiple: int = 1,
+) -> RandomEffectDataset:
+    """Host-side dataset build (the one-time "shuffle" of SURVEY.md §2.1 P13).
+
+    active_cap: numActiveDataPointsUpperBound — reservoir-cap per entity with
+    count/cap weight rescale. active_lower_bound: numActiveDataPointsLowerBound
+    — entities with fewer samples are not trained.
+    """
+    n = raw.n_rows
+    ids = raw.id_tags[random_effect_type]
+    rows, cols, vals = raw.shard_coo[feature_shard]
+
+    # --- group rows by entity ------------------------------------------------
+    uniq, inv = np.unique(ids.astype(str), return_inverse=True)
+    counts = np.bincount(inv, minlength=len(uniq))
+
+    kept_mask = counts >= active_lower_bound
+    kept_entities = np.nonzero(kept_mask)[0]
+    # order entities by descending size: natural bin-packing order for sharding
+    kept_entities = kept_entities[np.argsort(-counts[kept_entities], kind="stable")]
+    E_real = len(kept_entities)
+    E = max(
+        ((E_real + pad_entities_to_multiple - 1) // pad_entities_to_multiple)
+        * pad_entities_to_multiple,
+        pad_entities_to_multiple,
+    )
+    old_to_block = np.full(len(uniq), -1, dtype=np.int64)
+    old_to_block[kept_entities] = np.arange(E_real)
+
+    cap = active_cap if active_cap is not None else int(counts.max() if len(counts) else 1)
+    K = int(min(int(counts[kept_entities].max()) if E_real else 1, cap)) or 1
+
+    # --- per-entity active selection (deterministic reservoir) ---------------
+    row_ids = np.arange(n, dtype=np.int64)
+    priority = _hash64(row_ids, seed)
+    # sort rows by (entity, priority): active set = first K rows of each group
+    entity_of_row = old_to_block[inv]
+    order = np.lexsort((priority, entity_of_row))
+    sorted_rows = row_ids[order]
+    sorted_entity = entity_of_row[order]
+    # rank within entity group
+    if E_real:
+        starts = np.searchsorted(sorted_entity, np.arange(E_real))
+        rank = np.arange(n) - starts[np.clip(sorted_entity, 0, E_real - 1)]
+        is_active = (sorted_entity >= 0) & (rank < K)
+    else:
+        # every entity fell below active_lower_bound: empty (padded) blocks
+        rank = np.zeros(n, dtype=np.int64)
+        is_active = np.zeros(n, dtype=bool)
+
+    active_rows_np = np.full((E, K), -1, dtype=np.int64)
+    weight_scale = np.ones(E)
+    for e in range(E_real):
+        cnt = counts[kept_entities[e]]
+        if cnt > cap:
+            weight_scale[e] = cnt / cap
+    sel = np.nonzero(is_active)[0]
+    active_rows_np[sorted_entity[sel], rank[sel]] = sorted_rows[sel]
+
+    passive = sorted_rows[~is_active & (sorted_entity >= 0)]
+
+    # --- ELL features for all rows (scoring path) ----------------------------
+    ell_idx_np, ell_val_np = _rows_to_ell(rows, cols, vals, n)
+
+    # --- per-entity subspace projection (LinearSubspaceProjector) ------------
+    # vectorized inner ops; one short numpy pass per entity
+    S = 1
+    per_entity_cols: List[np.ndarray] = []
+    for e in range(E_real):
+        r = active_rows_np[e]
+        r = r[r >= 0]
+        c = np.unique(ell_idx_np[r][ell_val_np[r] != 0])
+        per_entity_cols.append(c)  # np.unique output is sorted
+        S = max(S, len(c))
+    proj_cols_np = np.full((E, S), -1, dtype=np.int32)
+    for e in range(E_real):
+        c = per_entity_cols[e]
+        proj_cols_np[e, : len(c)] = c
+
+    # --- dense projected blocks (vectorized per entity) ----------------------
+    feats = np.zeros((E, K, S), dtype=np.float64)
+    labels_b = np.zeros((E, K))
+    offsets_b = np.zeros((E, K))
+    weights_b = np.zeros((E, K))
+    for e in range(E_real):
+        ks = np.nonzero(active_rows_np[e] >= 0)[0]
+        r = active_rows_np[e, ks]
+        labels_b[e, ks] = raw.labels[r]
+        offsets_b[e, ks] = raw.offsets[r]
+        weights_b[e, ks] = raw.weights[r] * weight_scale[e]
+        cols_e = per_entity_cols[e]
+        if len(cols_e) == 0:
+            continue
+        fi = ell_idx_np[r]  # [k, F]
+        fv = ell_val_np[r]
+        pos = np.searchsorted(cols_e, fi)  # [k, F]
+        pos_c = np.clip(pos, 0, len(cols_e) - 1)
+        hit = (cols_e[pos_c] == fi) & (fv != 0.0)
+        kk, ff = np.nonzero(hit)
+        feats[e, ks[kk], pos_c[kk, ff]] = fv[kk, ff]
+
+    blocks = EntityBlocks(
+        features=jnp.asarray(feats, dtype),
+        labels=jnp.asarray(labels_b, dtype),
+        offsets=jnp.asarray(offsets_b, dtype),
+        weights=jnp.asarray(weights_b, dtype),
+        proj_cols=jnp.asarray(proj_cols_np),
+        active_rows=jnp.asarray(active_rows_np.astype(np.int32)),
+    )
+
+    row_entity = np.where(entity_of_row >= 0, entity_of_row, -1).astype(np.int32)
+    entity_ids = np.concatenate(
+        [uniq[kept_entities], np.asarray([f"__pad{i}" for i in range(E - E_real)], dtype=object)]
+    ) if E > E_real else uniq[kept_entities]
+
+    return RandomEffectDataset(
+        coordinate_id=coordinate_id,
+        feature_shard=feature_shard,
+        random_effect_type=random_effect_type,
+        entity_ids=entity_ids.astype(object),
+        blocks=blocks,
+        row_entity=jnp.asarray(row_entity),
+        ell_idx=jnp.asarray(ell_idx_np),
+        ell_val=jnp.asarray(ell_val_np, dtype),
+        passive_rows=passive,
+    )

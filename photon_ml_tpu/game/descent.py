@@ -1,0 +1,170 @@
+"""Coordinate descent over GAME coordinates with residual score exchange.
+
+Reference: photon-lib .../algorithm/CoordinateDescent.scala:43-670 — the outer
+loop trains each coordinate against the residual of all others, maintains the
+summed scores incrementally (summedScores - oldScores + newScores, :441-446),
+evaluates on validation data after every coordinate update, and tracks the
+best model seen by the primary validation metric (:607-622). Locked
+coordinates (partial retraining) are fetched, never trained (:280-300), and
+the invariant checks of checkInvariants:71-92 are enforced up front.
+
+Scores here are plain device arrays in fixed sample order, so the reference's
+fullOuterJoin RDD arithmetic is elementwise adds (SURVEY.md §2.1 P7).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import logging
+from typing import Dict, List, Mapping, Optional, Sequence, Tuple
+
+import jax.numpy as jnp
+import numpy as np
+
+from ..evaluation.suite import EvaluationResults, EvaluationSuite
+from ..models.game import GameModel
+from ..utils.timed import timed
+from .coordinate import Coordinate, ModelCoordinate
+
+logger = logging.getLogger("photon_ml_tpu")
+
+
+@dataclasses.dataclass
+class CoordinateDescentResult:
+    model: GameModel
+    evaluations: List[Tuple[str, EvaluationResults]]  # (coordinate, results) per update
+    best_evaluation: Optional[EvaluationResults]
+    trackers: Dict[str, object]  # coordinate -> last SolverResult
+
+
+@dataclasses.dataclass
+class ValidationContext:
+    """Validation-side scoring: per-coordinate score fn over the validation set."""
+
+    suite: EvaluationSuite
+    score_fns: Mapping[str, object]  # coordinate -> (model -> scores f[n_val])
+    offsets: np.ndarray  # base offsets of validation rows
+
+
+class CoordinateDescent:
+    """Train GAME coordinates by block coordinate descent."""
+
+    def __init__(
+        self,
+        coordinates: Mapping[str, Coordinate],  # ordered
+        n_iterations: int = 1,
+        validation: Optional[ValidationContext] = None,
+    ):
+        if not coordinates:
+            raise ValueError("CoordinateDescent needs at least one coordinate")
+        if n_iterations < 1:
+            raise ValueError(f"n_iterations must be >= 1: {n_iterations}")
+        # checkInvariants (CoordinateDescent.scala:71-92): locked coordinates
+        # must not be retrained; with a single coordinate multiple iterations
+        # are pointless (reference logs a warning).
+        self.coordinates = dict(coordinates)
+        self.order = list(coordinates)
+        self.n_iterations = n_iterations
+        self.validation = validation
+        n_trainable = sum(
+            0 if isinstance(c, ModelCoordinate) else 1 for c in self.coordinates.values()
+        )
+        if n_trainable == 0:
+            raise ValueError("all coordinates are locked; nothing to train")
+        if len(self.order) == 1 and n_iterations > 1:
+            logger.warning(
+                "single-coordinate descent with %d iterations is wasteful", n_iterations
+            )
+
+    def run(
+        self, initial_models: Optional[Mapping[str, object]] = None
+    ) -> CoordinateDescentResult:
+        initial_models = dict(initial_models or {})
+        coords = self.coordinates
+        n = next(iter(coords.values())).n_rows
+        for c in coords.values():
+            if c.n_rows != n:
+                raise ValueError(
+                    f"coordinate {c.coordinate_id} has {c.n_rows} rows, expected {n}"
+                )
+
+        models: Dict[str, object] = {}
+        trackers: Dict[str, object] = {}
+        scores: Dict[str, jnp.ndarray] = {}
+        # initialize scores from warm-start models where available
+        for name in self.order:
+            if name in initial_models:
+                models[name] = initial_models[name]
+                scores[name] = coords[name].score(initial_models[name])
+        zero = jnp.zeros((n,), jnp.float32)
+        summed = sum(scores.values(), zero)
+
+        evaluations: List[Tuple[str, EvaluationResults]] = []
+        best_eval: Optional[EvaluationResults] = None
+        best_models: Dict[str, object] = dict(models)
+
+        for it in range(self.n_iterations):
+            for name in self.order:
+                coordinate = coords[name]
+                own = scores.get(name)
+                residual = summed - own if own is not None else summed
+
+                with timed(f"cd iter {it} coordinate {name}: train"):
+                    model, tracker = coordinate.train(
+                        residual, initial_model=models.get(name)
+                    )
+                if tracker is not None:
+                    trackers[name] = tracker
+                models[name] = model
+
+                with timed(f"cd iter {it} coordinate {name}: score"):
+                    new_scores = coordinate.score(model)
+                # summedScores - oldScores + newScores (:441-446)
+                summed = residual + new_scores
+                scores[name] = new_scores
+
+                if self.validation is not None:
+                    res = self._evaluate(models)
+                    evaluations.append((name, res))
+                    primary = self.validation.suite.primary
+                    # only snapshots with every coordinate trained are
+                    # candidates for "best model" — a mid-first-sweep partial
+                    # model is not a valid GAME model
+                    complete = len(models) == len(self.order)
+                    if complete and (
+                        best_eval is None
+                        or primary.better(res.primary_metric, best_eval.primary_metric)
+                    ):
+                        best_eval = res
+                        best_models = dict(models)
+                    logger.info(
+                        "cd iter %d coordinate %s: %s", it, name, res.metrics
+                    )
+
+        final_models = best_models if best_eval is not None else models
+        task = self._infer_task()
+        return CoordinateDescentResult(
+            model=GameModel(models=final_models, task=task),
+            evaluations=evaluations,
+            best_evaluation=best_eval,
+            trackers=trackers,
+        )
+
+    def _infer_task(self) -> str:
+        """Task from the coordinate definitions (every trainable coordinate
+        carries it; locked ModelCoordinates delegate to their inner)."""
+        for c in self.coordinates.values():
+            inner = c.inner if isinstance(c, ModelCoordinate) else c
+            task = getattr(inner, "task", None)
+            if task:
+                return task
+        return "linear_regression"
+
+    def _evaluate(self, models: Mapping[str, object]) -> EvaluationResults:
+        v = self.validation
+        total = np.asarray(v.offsets, dtype=np.float64).copy()
+        for name, model in models.items():
+            fn = v.score_fns.get(name)
+            if fn is not None:
+                total = total + np.asarray(fn(model), dtype=np.float64)
+        return v.suite.evaluate(total)

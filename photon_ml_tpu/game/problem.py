@@ -89,9 +89,9 @@ class GLMProblem:
         else:
             w0 = jnp.zeros(batch.dim, dtype)
 
-        result = optimize(
-            obj.value_and_grad, w0, self.config.solver_config(), hvp=obj.hessian_vector
-        )
+        from ..ops.glm import hvp_fn, vg_fn
+
+        result = optimize(vg_fn(obj), w0, self.config.solver_config(), hvp=hvp_fn(obj))
 
         variances = compute_variances(obj, result.coefficients, self.config.variance_type)
 

@@ -1,0 +1,49 @@
+"""Down-samplers for fixed-effect training.
+
+Reference: photon-lib .../sampling/ — BinaryClassificationDownSampler.scala:46-69
+(keep all positives; keep negatives with probability r and rescale their weight
+by 1/r) and DefaultDownSampler.scala (uniform row sample), selected per task in
+DownSamplerHelper.scala:26-40.
+
+Down-sampling only affects the *training* batch; scoring always sees all rows.
+Realized as a weight transform (dropped rows get weight 0) so batch shapes stay
+static for jit; determinism comes from a seeded ``numpy`` generator, mirroring
+the reference's per-partition deterministic seeds (recomputability, SURVEY §5).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import jax.numpy as jnp
+
+from ..ops.features import LabeledBatch
+from ..ops.losses import POSITIVE_RESPONSE_THRESHOLD
+
+_BINARY_TASKS = {"logistic_regression", "smoothed_hinge_loss_linear_svm"}
+
+
+def is_binary_task(task: str) -> bool:
+    return task.lower() in _BINARY_TASKS
+
+
+def down_sample(
+    batch: LabeledBatch, task: str, rate: float, seed: int = 0
+) -> LabeledBatch:
+    """Return a batch with down-sampled weights (no-op when rate >= 1)."""
+    if rate >= 1.0:
+        return batch
+    if not (0.0 < rate < 1.0):
+        raise ValueError(f"down-sampling rate must be in (0, 1): {rate}")
+    rng = np.random.default_rng(seed)
+    n = batch.n_rows
+    keep = rng.uniform(size=n) < rate
+    labels = np.asarray(batch.labels)
+    weights = np.asarray(batch.weights)
+    if is_binary_task(task):
+        pos = labels > POSITIVE_RESPONSE_THRESHOLD
+        new_w = np.where(pos, weights, np.where(keep, weights / rate, 0.0))
+    else:
+        new_w = np.where(keep, weights, 0.0)
+    import dataclasses
+
+    return dataclasses.replace(batch, weights=jnp.asarray(new_w, batch.weights.dtype))

@@ -51,6 +51,26 @@ class RawDataset:
     id_tags: Dict[str, np.ndarray]  # tag -> object array of per-row ids
     uids: Optional[np.ndarray] = None
 
+    def subset(self, rows: np.ndarray) -> "RawDataset":
+        """Row-subset view (train/validation splits; host-side)."""
+        rows = np.asarray(rows)
+        old_to_new = np.full(self.n_rows, -1, dtype=np.int64)
+        old_to_new[rows] = np.arange(len(rows))
+        new_coo = {}
+        for s, (r, c, v) in self.shard_coo.items():
+            keep = old_to_new[r] >= 0
+            new_coo[s] = (old_to_new[r[keep]], c[keep], v[keep])
+        return RawDataset(
+            n_rows=len(rows),
+            labels=self.labels[rows],
+            offsets=self.offsets[rows],
+            weights=self.weights[rows],
+            shard_coo=new_coo,
+            shard_dims=dict(self.shard_dims),
+            id_tags={t: v[rows] for t, v in self.id_tags.items()},
+            uids=None if self.uids is None else self.uids[rows],
+        )
+
     def to_batch(self, shard: str, dtype=None, layout: str = "auto"):
         """Build a device LabeledBatch for one feature shard.
 

@@ -30,6 +30,37 @@ from .glm import GeneralizedLinearModel, model_for_task
 Array = jax.Array
 
 
+def score_entity_ell(
+    coef_indices: Array,  # i32[E, S] sorted ascending per row, -1 padded
+    coef_values: Array,  # f[E, S]
+    entity_rows: Array,  # i32[n], -1 = unseen entity
+    feat_idx: Array,  # i32[n, F]
+    feat_val: Array,  # f[n, F]
+) -> Array:
+    """Pure scoring kernel: per-row dot product against per-entity sparse
+    coefficient vectors (RandomEffectModel.score semantics; jit/vmap/shard-safe).
+
+    Per row i: score = sum_k feat_val[i,k] * w_e[feat_idx[i,k]] with w_e the
+    sparse vector of entity entity_rows[i]; the lookup is a searchsorted into
+    the entity's sorted support (-1 padding replaced by a +inf sentinel keeps
+    the row sorted)."""
+    safe_rows = jnp.maximum(entity_rows, 0)
+    ent_idx = jnp.take(coef_indices, safe_rows, axis=0)  # [n, S]
+    ent_val = jnp.take(coef_values, safe_rows, axis=0)  # [n, S]
+    big = jnp.iinfo(jnp.int32).max
+    ent_idx_search = jnp.where(ent_idx < 0, big, ent_idx)
+
+    def one(ei, ev, fi, fv):
+        pos = jnp.searchsorted(ei, fi)
+        pos = jnp.clip(pos, 0, ei.shape[0] - 1)
+        hit = jnp.take(ei, pos) == fi
+        w = jnp.where(hit, jnp.take(ev, pos), 0.0)
+        return jnp.sum(w * fv)
+
+    scores = jax.vmap(one)(ent_idx_search, ent_val, feat_idx, feat_val)
+    return jnp.where(entity_rows >= 0, scores, 0.0)
+
+
 @dataclasses.dataclass(frozen=True)
 class FixedEffectModel:
     """One GLM applied to every sample's features from one feature shard."""
@@ -91,28 +122,10 @@ class RandomEffectModel:
         """Score rows in ELL layout: row i gets features (feat_idx[i], feat_val[i])
         and entity row entity_rows[i] (-1 => unseen => score 0).
 
-        Per row: score = sum_k feat_val[k] * w_e[feat_idx[k]], where w_e is the
-        entity's sparse vector; the lookup is a searchsorted into the entity's
-        sorted support (coef_indices rows are sorted ascending with -1 padding
-        moved to the FRONT so valid entries form the sorted suffix... indices
-        are stored sorted ascending with -1 padding at the END replaced by a
-        large sentinel during search).
-        """
-        safe_rows = jnp.maximum(entity_rows, 0)
-        ent_idx = jnp.take(self.coef_indices, safe_rows, axis=0)  # [n, S]
-        ent_val = jnp.take(self.coef_values, safe_rows, axis=0)  # [n, S]
-        big = jnp.iinfo(jnp.int32).max
-        ent_idx_search = jnp.where(ent_idx < 0, big, ent_idx)
-
-        def one(ei, ev, fi, fv):
-            pos = jnp.searchsorted(ei, fi)
-            pos = jnp.clip(pos, 0, ei.shape[0] - 1)
-            hit = jnp.take(ei, pos) == fi
-            w = jnp.where(hit, jnp.take(ev, pos), 0.0)
-            return jnp.sum(w * fv)
-
-        scores = jax.vmap(one)(ent_idx_search, ent_val, feat_idx, feat_val)
-        return jnp.where(entity_rows >= 0, scores, 0.0)
+        Delegates to :func:`score_entity_ell`."""
+        return score_entity_ell(
+            self.coef_indices, self.coef_values, entity_rows, feat_idx, feat_val
+        )
 
 
 @dataclasses.dataclass

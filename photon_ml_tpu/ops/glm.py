@@ -54,7 +54,10 @@ class GLMObjective:
 
     loss: PointwiseLoss
     batch: LabeledBatch
-    l2: float = dataclasses.field(default=0.0, metadata=dict(static=True))
+    # dynamic leaf (not static): lambda sweeps must NOT trigger recompiles —
+    # the reference kept a mutable reg weight for exactly this reason
+    # (DistributedOptimizationProblem.updateRegularizationWeight:64-75)
+    l2: float = 0.0
     norm: Optional[NormalizationContext] = None
 
     def _norm(self) -> NormalizationContext:
@@ -85,9 +88,8 @@ class GLMObjective:
             grad = grad - norm.shifts * jnp.sum(wdz)
         if norm.factors is not None:
             grad = grad * norm.factors
-        if self.l2 > 0.0:
-            value = value + 0.5 * self.l2 * jnp.dot(coef, coef)
-            grad = grad + self.l2 * coef
+        value = value + 0.5 * self.l2 * jnp.dot(coef, coef)
+        grad = grad + self.l2 * coef
         return value, grad
 
     def _d2z_weights(self, coef: Array) -> Array:
@@ -113,8 +115,7 @@ class GLMObjective:
             hv = hv - norm.shifts * jnp.sum(c)
         if norm.factors is not None:
             hv = hv * norm.factors
-        if self.l2 > 0.0:
-            hv = hv + self.l2 * v
+        hv = hv + self.l2 * v
         return hv
 
     def hessian_diagonal(self, coef: Array) -> Array:
@@ -133,8 +134,7 @@ class GLMObjective:
             diag = s2 - 2.0 * norm.shifts * s1 + norm.shifts**2 * s0
         if norm.factors is not None:
             diag = diag * norm.factors**2
-        if self.l2 > 0.0:
-            diag = diag + self.l2
+        diag = diag + self.l2
         return diag
 
     def hessian_matrix(self, coef: Array) -> Array:
@@ -150,9 +150,28 @@ class GLMObjective:
         if norm.factors is not None:
             x = x * norm.factors[None, :]
         h = x.T @ (c[:, None] * x)
-        if self.l2 > 0.0:
-            h = h + self.l2 * jnp.eye(h.shape[0], dtype=h.dtype)
+        h = h + self.l2 * jnp.eye(h.shape[0], dtype=h.dtype)
         return h
+
+
+def _vg(obj: "GLMObjective", coef: Array):
+    return obj.value_and_grad(coef)
+
+
+def _hvp(obj: "GLMObjective", coef: Array, v: Array) -> Array:
+    return obj.hessian_vector(coef, v)
+
+
+def vg_fn(obj: GLMObjective):
+    """value_and_grad as a jit-cache-stable pytree callable: the function
+    identity is the module-level _vg, the objective rides along as a pytree
+    argument — repeated solver calls with fresh GLMObjective instances of the
+    same structure REUSE the compiled solver instead of recompiling."""
+    return jax.tree_util.Partial(_vg, obj)
+
+
+def hvp_fn(obj: GLMObjective):
+    return jax.tree_util.Partial(_hvp, obj)
 
 
 def compute_variances(

@@ -132,13 +132,31 @@ def check_convergence(
     return reason.astype(jnp.int32)
 
 
+def as_partial(fn):
+    """Wrap a callable as a jax.tree_util.Partial so it can flow through jit
+    as a DYNAMIC argument: the jit cache keys on the underlying function
+    identity + pytree structure, so fresh objective objects of the same
+    structure reuse compiled solvers instead of recompiling (essential: a
+    remote-compile environment pays tens of seconds per recompile)."""
+    if isinstance(fn, jax.tree_util.Partial):
+        return fn
+    return jax.tree_util.Partial(fn)
+
+
+@jax.jit
+def _abs_tolerances_impl(value_and_grad, zero_like: Array, tolerance: Array):
+    f0, g0 = value_and_grad(jnp.zeros_like(zero_like))
+    return jnp.abs(f0) * tolerance, _norm(g0) * tolerance
+
+
 def abs_tolerances(
     value_and_grad: ValueAndGradFn, zero_like: Array, tolerance: float
 ) -> Tuple[Array, Array]:
     """Absolute tolerances from the state at zero coefficients
     (Optimizer.scala:65-69 + :171)."""
-    f0, g0 = value_and_grad(jnp.zeros_like(zero_like))
-    return jnp.abs(f0) * tolerance, _norm(g0) * tolerance
+    return _abs_tolerances_impl(
+        as_partial(value_and_grad), zero_like, jnp.asarray(tolerance, zero_like.dtype)
+    )
 
 
 def _norm(v: Array) -> Array:

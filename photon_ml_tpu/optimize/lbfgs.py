@@ -33,6 +33,7 @@ from .common import (
     ConvergenceReason,
     SolverResult,
     ValueAndGradFn,
+    as_partial,
     check_convergence,
     project_box,
 )
@@ -216,7 +217,6 @@ class _LBFGSState(NamedTuple):
 @partial(
     jax.jit,
     static_argnames=(
-        "value_and_grad",
         "max_iterations",
         "num_corrections",
         "l1_weight",
@@ -393,7 +393,7 @@ def solve_lbfgs(
     zero = jnp.zeros_like(w0)
     lower, upper = box_constraints if has_box else (zero, zero)
     return _solve(
-        value_and_grad,
+        as_partial(value_and_grad),
         w0,
         jnp.asarray(loss_abs_tol, w0.dtype),
         jnp.asarray(grad_abs_tol, w0.dtype),

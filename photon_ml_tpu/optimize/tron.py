@@ -31,6 +31,7 @@ from .common import (
     HvpFn,
     SolverResult,
     ValueAndGradFn,
+    as_partial,
     check_convergence,
     project_box,
 )
@@ -141,8 +142,6 @@ class _TronState(NamedTuple):
 @partial(
     jax.jit,
     static_argnames=(
-        "value_and_grad",
-        "hvp",
         "max_iterations",
         "max_cg_iterations",
         "max_improvement_failures",
@@ -290,8 +289,8 @@ def solve_tron(
     zero = jnp.zeros_like(w0)
     lower, upper = box_constraints if has_box else (zero, zero)
     return _solve(
-        value_and_grad,
-        hvp,
+        as_partial(value_and_grad),
+        as_partial(hvp),
         w0,
         jnp.asarray(loss_abs_tol, w0.dtype),
         jnp.asarray(grad_abs_tol, w0.dtype),

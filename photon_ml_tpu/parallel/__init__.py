@@ -1,0 +1,23 @@
+from .mesh import (
+    DATA_AXIS,
+    MODEL_AXIS,
+    data_parallel_mesh,
+    make_mesh,
+    pad_rows_for_mesh,
+    replicate,
+    shard_batch,
+    shard_coefficients,
+    shard_entity_blocks,
+)
+
+__all__ = [
+    "DATA_AXIS",
+    "MODEL_AXIS",
+    "make_mesh",
+    "data_parallel_mesh",
+    "pad_rows_for_mesh",
+    "shard_batch",
+    "shard_coefficients",
+    "shard_entity_blocks",
+    "replicate",
+]

@@ -1,0 +1,11 @@
+from .generators import (
+    generate_game_records,
+    generate_glm_data,
+    generate_mixed_effect_data,
+)
+
+__all__ = [
+    "generate_glm_data",
+    "generate_mixed_effect_data",
+    "generate_game_records",
+]

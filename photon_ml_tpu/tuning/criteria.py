@@ -1,0 +1,23 @@
+"""Acquisition criteria for Bayesian search (minimization convention).
+
+Reference: photon-lib .../hyperparameter/criteria/ —
+ExpectedImprovement.scala:33-58, ConfidenceBound.scala:48.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+from scipy.stats import norm
+
+
+def expected_improvement(best: float, mean: np.ndarray, var: np.ndarray) -> np.ndarray:
+    """EI of improving BELOW ``best`` (we minimize the evaluation metric)."""
+    std = np.sqrt(var)
+    gamma = (best - mean) / std
+    return std * (gamma * norm.cdf(gamma) + norm.pdf(gamma))
+
+
+def confidence_bound(mean: np.ndarray, var: np.ndarray, explore: float = 2.0) -> np.ndarray:
+    """Lower confidence bound, negated so that HIGHER = more promising
+    (uniform "pick argmax of acquisition" convention)."""
+    return -(mean - explore * np.sqrt(var))

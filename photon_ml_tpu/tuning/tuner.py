@@ -1,0 +1,66 @@
+"""Hyperparameter tuner plug-in surface.
+
+Reference: photon-api .../hyperparameter/tuner/ — HyperparameterTuner.scala:39
+(search(n, dimension, mode, evaluationFunction, observations)),
+HyperparameterTunerFactory.scala:20-48 (DUMMY no-op default; the production
+tuner resolved reflectively). Here the in-repo Bayesian tuner IS the
+production path: mode RANDOM -> Sobol search, BAYESIAN -> GP search.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import List, Optional, Sequence
+
+import numpy as np
+
+from .search import EvaluationFn, GaussianProcessSearch, Observation, RandomSearch
+
+TUNER_DUMMY = "DUMMY"
+TUNER_RANDOM = "RANDOM"
+TUNER_BAYESIAN = "BAYESIAN"
+
+
+class HyperparameterTuner:
+    def search(
+        self,
+        n: int,
+        dimension: int,
+        evaluation_function: EvaluationFn,
+        observations: Optional[Sequence[Observation]] = None,
+        discrete_params=None,
+        seed: int = 0,
+    ) -> List[Observation]:
+        raise NotImplementedError
+
+
+class DummyTuner(HyperparameterTuner):
+    """No-op tuner (DummyTuner.scala:39): returns no new observations."""
+
+    def search(self, n, dimension, evaluation_function, observations=None, discrete_params=None, seed=0):
+        return []
+
+
+class RandomTuner(HyperparameterTuner):
+    def search(self, n, dimension, evaluation_function, observations=None, discrete_params=None, seed=0):
+        return RandomSearch(dimension, evaluation_function, discrete_params, seed).find(
+            n, observations=observations
+        )
+
+
+class BayesianTuner(HyperparameterTuner):
+    def search(self, n, dimension, evaluation_function, observations=None, discrete_params=None, seed=0):
+        return GaussianProcessSearch(
+            dimension, evaluation_function, discrete_params, seed=seed
+        ).find(n, observations=observations)
+
+
+def get_tuner(name: str) -> HyperparameterTuner:
+    key = name.upper()
+    if key == TUNER_DUMMY:
+        return DummyTuner()
+    if key == TUNER_RANDOM:
+        return RandomTuner()
+    if key in (TUNER_BAYESIAN, "ATLAS"):
+        return BayesianTuner()
+    raise ValueError(f"Unknown hyperparameter tuner: {name!r}")

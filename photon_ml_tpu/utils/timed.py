@@ -1,0 +1,19 @@
+"""Named wall-clock sections (reference: photon-lib .../util/Timed.scala:33-83,
+used at every driver/estimator stage)."""
+
+from __future__ import annotations
+
+import contextlib
+import logging
+import time
+
+logger = logging.getLogger("photon_ml_tpu")
+
+
+@contextlib.contextmanager
+def timed(name: str, level: int = logging.DEBUG):
+    t0 = time.perf_counter()
+    try:
+        yield
+    finally:
+        logger.log(level, "%s took %.3fs", name, time.perf_counter() - t0)

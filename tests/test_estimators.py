@@ -1,0 +1,168 @@
+"""GameEstimator / GameTransformer tests: multi-config grids with warm start,
+validation-driven selection, partial retrain, transform round trips."""
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from photon_ml_tpu.estimators import (
+    CoordinateConfig,
+    GameEstimator,
+    GameTransformer,
+)
+from photon_ml_tpu.game.problem import GLMOptimizationConfig
+from photon_ml_tpu.io import load_game_model, save_game_model
+from photon_ml_tpu.io.index_map import IndexMap, feature_key
+from photon_ml_tpu.ops.regularization import RegularizationContext
+from photon_ml_tpu.optimize import OptimizerConfig
+from photon_ml_tpu.testing import generate_mixed_effect_data
+from photon_ml_tpu.testing.generators import mixed_data_to_raw_dataset
+
+
+@pytest.fixture(scope="module")
+def game_data():
+    # one generating model; rows split into train/validation so the learned
+    # per-entity effects actually transfer
+    full = mixed_data_to_raw_dataset(
+        generate_mixed_effect_data(
+            n=1800, d_fixed=6, re_specs={"userId": (24, 4)}, seed=21
+        )
+    )
+    return full.subset(np.arange(1200)), full.subset(np.arange(1200, 1800))
+
+
+def _configs(reg_weights_fe=(1.0,), reg_weights_re=(1.0,)):
+    opt = OptimizerConfig(tolerance=1e-8, max_iterations=100)
+    return [
+        CoordinateConfig(
+            name="global",
+            feature_shard="global",
+            config=GLMOptimizationConfig(
+                optimizer=opt, regularization=RegularizationContext("L2")
+            ),
+            reg_weights=reg_weights_fe,
+        ),
+        CoordinateConfig(
+            name="per-user",
+            feature_shard="userShard",
+            random_effect_type="userId",
+            config=GLMOptimizationConfig(
+                optimizer=opt, regularization=RegularizationContext("L2")
+            ),
+            reg_weights=reg_weights_re,
+        ),
+    ]
+
+
+def test_fit_single_config(game_data):
+    train, val = game_data
+    est = GameEstimator(
+        task="logistic_regression",
+        coordinate_configs=_configs(),
+        n_cd_iterations=2,
+        evaluator_specs=["AUC", "LOGISTIC_LOSS"],
+        dtype=jnp.float64,
+    )
+    results = est.fit(train, validation=val)
+    assert len(results) == 1
+    r = results[0]
+    assert set(r.model.coordinates()) == {"global", "per-user"}
+    assert r.evaluation is not None and r.evaluation.metrics["AUC"] > 0.7
+
+
+def test_fit_grid_cartesian_product(game_data):
+    train, val = game_data
+    est = GameEstimator(
+        task="logistic_regression",
+        coordinate_configs=_configs(reg_weights_fe=(0.1, 10.0), reg_weights_re=(1.0, 5.0)),
+        evaluator_specs=["AUC"],
+        dtype=jnp.float64,
+    )
+    results = est.fit(train, validation=val)
+    assert len(results) == 4
+    combos = {(r.config["global"], r.config["per-user"]) for r in results}
+    assert combos == {(0.1, 1.0), (0.1, 5.0), (10.0, 1.0), (10.0, 5.0)}
+    best = est.select_best(results)
+    assert best.evaluation.metrics["AUC"] == max(
+        r.evaluation.metrics["AUC"] for r in results
+    )
+
+
+def test_transform_and_model_io_round_trip(game_data, tmp_path):
+    train, val = game_data
+    est = GameEstimator(
+        task="logistic_regression",
+        coordinate_configs=_configs(),
+        evaluator_specs=["AUC"],
+        dtype=jnp.float64,
+    )
+    result = est.fit(train, validation=val)[0]
+
+    transformer = GameTransformer(model=result.model, dtype=jnp.float64)
+    scores, ev = transformer.transform(val, evaluator_specs=["AUC"])
+    assert scores.shape == (val.n_rows,)
+    np.testing.assert_allclose(
+        ev.metrics["AUC"], result.evaluation.metrics["AUC"], atol=1e-9
+    )
+
+    # save -> load -> transform must reproduce scores
+    imaps = {
+        "global": IndexMap({feature_key(f"g{j}"): j for j in range(6)}),
+        "userShard": IndexMap({feature_key(f"u{j}"): j for j in range(4)}),
+    }
+    d = str(tmp_path / "gm")
+    save_game_model(d, result.model, imaps)
+    back = load_game_model(d, imaps)
+    scores2, _ = GameTransformer(model=back, dtype=jnp.float64).transform(val)
+    np.testing.assert_allclose(scores2, scores, atol=1e-6)
+
+
+def test_partial_retrain(game_data):
+    train, val = game_data
+    est = GameEstimator(
+        task="logistic_regression",
+        coordinate_configs=_configs(),
+        evaluator_specs=["AUC"],
+        dtype=jnp.float64,
+    )
+    first = est.fit(train, validation=val)[0]
+
+    est2 = GameEstimator(
+        task="logistic_regression",
+        coordinate_configs=_configs(reg_weights_re=(3.0,)),
+        evaluator_specs=["AUC"],
+        dtype=jnp.float64,
+        partial_retrain_locked=["global"],
+    )
+    second = est2.fit(train, validation=val, initial_model=first.model)[0]
+    np.testing.assert_allclose(
+        np.asarray(second.model["global"].model.coefficients.means),
+        np.asarray(first.model["global"].model.coefficients.means),
+    )
+    # the RE coordinate did retrain (different reg weight -> different coefs)
+    assert not np.allclose(
+        np.asarray(second.model["per-user"].coef_values),
+        np.asarray(first.model["per-user"].coef_values),
+    )
+
+
+def test_unseen_validation_entities_score_zero(game_data):
+    train, _ = game_data
+    # validation with entity ids the model never saw
+    val2 = generate_mixed_effect_data(
+        n=100, d_fixed=6, re_specs={"userId": (5, 4)}, seed=99
+    )
+    raw2 = mixed_data_to_raw_dataset(val2)
+    raw2.id_tags["userId"] = np.asarray(
+        [f"unseen{i}" for i in range(raw2.n_rows)], dtype=object
+    )
+    est = GameEstimator(
+        task="logistic_regression", coordinate_configs=_configs(), dtype=jnp.float64
+    )
+    model = est.fit(train)[0].model
+    scores_game, _ = GameTransformer(model=model, dtype=jnp.float64).transform(raw2)
+    # only the fixed effect contributes
+    fe = model["global"]
+    batch = raw2.to_batch("global", dtype=jnp.float64)
+    expected = np.asarray(batch.features.matvec(fe.model.coefficients.means))
+    np.testing.assert_allclose(scores_game, expected + raw2.offsets, atol=1e-8)

@@ -1,0 +1,133 @@
+"""Hyperparameter tuning tests: GP regression sanity, slice sampler, EI,
+rescaling, and end-to-end Bayesian search beating random search on a known
+function (mirrors the reference's hyperparameter/** unit suites)."""
+
+import numpy as np
+import pytest
+
+from photon_ml_tpu.tuning import (
+    BayesianTuner,
+    DummyTuner,
+    GaussianProcessEstimator,
+    GaussianProcessModel,
+    GaussianProcessSearch,
+    HyperparameterConfig,
+    Matern52,
+    ParamRange,
+    RBF,
+    RandomSearch,
+    expected_improvement,
+    get_tuner,
+    slice_sample,
+)
+
+
+def test_kernels_psd(rng):
+    x = rng.uniform(size=(20, 3))
+    for kern in (RBF(lengthscale=np.asarray([0.5])), Matern52(lengthscale=np.asarray([0.5]))):
+        k = kern.cov(x)
+        np.testing.assert_allclose(k, k.T, atol=1e-12)
+        w = np.linalg.eigvalsh(k)
+        assert w.min() > -1e-9
+        # k(x,x) = amplitude on the diagonal
+        np.testing.assert_allclose(np.diag(k), kern.amplitude, rtol=1e-10)
+
+
+def test_gp_interpolates_noise_free(rng):
+    x = rng.uniform(size=(12, 1)) * 4
+    y = np.sin(x[:, 0])
+    gp = GaussianProcessModel(Matern52(noise=1e-8, lengthscale=np.asarray([1.0])), x, y)
+    mu, var = gp.predict(x)
+    np.testing.assert_allclose(mu, y, atol=1e-4)
+    assert var.max() < 1e-4
+    # between points, prediction approximates sin with small error
+    xs = np.linspace(0.2, 3.8, 25)[:, None]
+    mu2, _ = gp.predict(xs)
+    assert np.abs(mu2 - np.sin(xs[:, 0])).max() < 0.1
+
+
+def test_gp_estimator_fits_reasonably(rng):
+    x = rng.uniform(size=(25, 2))
+    y = np.sin(3 * x[:, 0]) + 0.5 * x[:, 1] + 0.05 * rng.normal(size=25)
+    post = GaussianProcessEstimator(seed=1).fit(x, y)
+    mu, var = post.predict(x)
+    assert np.corrcoef(mu, y)[0, 1] > 0.95
+
+
+def test_slice_sampler_matches_gaussian():
+    rng = np.random.default_rng(3)
+    logp = lambda x: float(-0.5 * ((x[0] - 2.0) / 1.5) ** 2)
+    samples = slice_sample(logp, np.zeros(1), 3000, rng, burn_in=50)
+    assert abs(samples.mean() - 2.0) < 0.15
+    assert abs(samples.std() - 1.5) < 0.2
+
+
+def test_expected_improvement_properties():
+    # lower mean -> higher EI; higher var -> higher EI at equal mean
+    ei = expected_improvement(0.0, np.asarray([-1.0, 0.0, 1.0]), np.asarray([1.0, 1.0, 1.0]))
+    assert ei[0] > ei[1] > ei[2]
+    ei2 = expected_improvement(0.0, np.asarray([1.0, 1.0]), np.asarray([0.01, 4.0]))
+    assert ei2[1] > ei2[0]
+
+
+def test_rescaling_round_trip():
+    cfg = HyperparameterConfig(
+        params=[
+            ParamRange("lambda", 1e-4, 1e4, transform="LOG"),
+            ParamRange("alpha", 0.0, 1.0),
+            ParamRange("depth", 1, 10, discrete=True),
+        ]
+    )
+    native = cfg.scale_up(np.asarray([0.5, 0.25, 0.47]))
+    np.testing.assert_allclose(native[0], 1.0, rtol=1e-10)  # log midpoint of 1e-4..1e4
+    assert native[1] == 0.25
+    assert native[2] == round(native[2])
+    unit = cfg.scale_down(native)
+    np.testing.assert_allclose(unit[:2], [0.5, 0.25], atol=1e-12)
+    # JSON round trip
+    cfg2 = HyperparameterConfig.from_json(cfg.to_json())
+    assert cfg2 == cfg
+
+
+def _quadratic_eval(x):
+    # minimum at (0.3, 0.7)
+    v = (x[0] - 0.3) ** 2 + (x[1] - 0.7) ** 2
+    return float(v), None
+
+
+def test_random_search_runs():
+    rs = RandomSearch(2, _quadratic_eval, seed=5)
+    obs = rs.find(16)
+    assert len(obs) == 16
+    assert min(o.value for o in obs) < 0.15
+
+
+def test_bayesian_beats_random():
+    n_iters = 25
+    rs_best = min(o.value for o in RandomSearch(2, _quadratic_eval, seed=11).find(n_iters))
+    gp_best = min(
+        o.value
+        for o in GaussianProcessSearch(2, _quadratic_eval, seed=11).find(n_iters)
+    )
+    assert gp_best <= rs_best + 1e-9
+    assert gp_best < 0.01
+
+
+def test_bayesian_with_discrete_dim():
+    def ev(x):
+        return float((x[0] - 0.5) ** 2 + x[1]), None
+
+    gs = GaussianProcessSearch(2, ev, discrete_params={1: 3}, seed=2)
+    obs = gs.find(12)
+    vals = {round(o.candidate[1], 6) for o in obs}
+    assert vals <= {0.0, 0.5, 1.0}
+
+
+def test_tuner_factory():
+    assert isinstance(get_tuner("DUMMY"), DummyTuner)
+    assert isinstance(get_tuner("BAYESIAN"), BayesianTuner)
+    assert get_tuner("DUMMY").search(5, 2, _quadratic_eval) == []
+    obs = get_tuner("RANDOM").search(4, 2, _quadratic_eval)
+    assert len(obs) == 4
+    with pytest.raises(ValueError):
+        get_tuner("nope")
