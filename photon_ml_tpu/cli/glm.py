@@ -1,0 +1,204 @@
+"""Legacy single-GLM training driver.
+
+Reference: photon-client .../Driver.scala:92-561 (§3.3) — the staged non-GAME
+pipeline: INIT -> PREPROCESSED (read + validate + feature summary) ->
+TRAINED (lambda grid with warm start) -> VALIDATED (metrics per lambda, best
+model selection), with box-constrained optimization (GLMSuite constraint map)
+and text + Avro model output (IOUtils.writeModelsInText).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import logging
+import os
+import sys
+from typing import List, Optional
+
+import jax.numpy as jnp
+import numpy as np
+
+from ..estimators.model_training import select_best_model, train_glm_grid
+from ..evaluation.suite import build_suite
+from ..game.problem import GLMOptimizationConfig
+from ..io import read_avro_dataset, read_libsvm, save_glm
+from ..io.data import FeatureShardConfig
+from ..io.validators import VALIDATE_FULL, validate_dataset
+from ..ops.normalization import build_normalization
+from ..ops.regularization import RegularizationContext
+from ..optimize import OptimizerConfig, OptimizerType
+from ..utils.logging import setup_logging
+from ..utils.stats import compute_feature_statistics
+from .params import add_common_io_args, build_shard_configs
+
+logger = logging.getLogger("photon_ml_tpu")
+
+STAGES = ["INIT", "PREPROCESSED", "TRAINED", "VALIDATED"]
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser("photon-ml-tpu legacy GLM training driver")
+    add_common_io_args(p)
+    p.add_argument("--validation-data", default=None)
+    p.add_argument("--input-format", default="AVRO", choices=["AVRO", "LIBSVM"])
+    p.add_argument("--task", default="logistic_regression")
+    p.add_argument("--optimizer", default="LBFGS", choices=[t.value for t in OptimizerType])
+    p.add_argument("--tolerance", type=float, default=1e-7)
+    p.add_argument("--max-iterations", type=int, default=100)
+    p.add_argument("--regularization-type", default="NONE")
+    p.add_argument("--elastic-net-alpha", type=float, default=1.0)
+    p.add_argument("--regularization-weights", default="0", help="pipe-separated grid")
+    p.add_argument(
+        "--normalization",
+        default="NONE",
+        choices=["NONE", "STANDARDIZATION", "SCALE_WITH_STANDARD_DEVIATION", "SCALE_WITH_MAX_MAGNITUDE"],
+    )
+    p.add_argument("--evaluators", default="")
+    p.add_argument(
+        "--constraint-map",
+        default=None,
+        help='JSON map feature-key -> [lower, upper] box constraints',
+    )
+    p.add_argument(
+        "--validate-data", default=VALIDATE_FULL,
+        choices=["VALIDATE_FULL", "VALIDATE_SAMPLE", "DISABLED"],
+    )
+    p.add_argument("--variance-type", default="NONE", choices=["NONE", "SIMPLE", "FULL"])
+    p.add_argument("--output-dir", required=True)
+    p.add_argument("--log-level", default="INFO")
+    return p
+
+
+def run(argv: Optional[List[str]] = None):
+    args = build_parser().parse_args(argv)
+    setup_logging(args.log_level)
+    stage = "INIT"
+
+    # ---- PREPROCESS ----------------------------------------------------------
+    if args.input_format == "LIBSVM":
+        raw = read_libsvm(args.input_data)
+        index_maps = None
+        shard = "global"
+        validation = read_libsvm(args.validation_data, dim=raw.shard_dims["global"] - 1) if args.validation_data else None
+    else:
+        shards = build_shard_configs(args)
+        shard = next(iter(shards))
+        raw, index_maps = read_avro_dataset(
+            args.input_data, shards, response_column=args.response_column
+        )
+        validation = None
+        if args.validation_data:
+            validation, _ = read_avro_dataset(
+                args.validation_data, shards, index_maps=index_maps,
+                response_column=args.response_column,
+            )
+    validate_dataset(raw, args.task, args.validate_data)
+    stats = compute_feature_statistics(raw, shard)
+    stage = "PREPROCESSED"
+    logger.info("stage %s: %d rows, %d features", stage, raw.n_rows, raw.shard_dims[shard])
+
+    # ---- TRAIN ---------------------------------------------------------------
+    batch = raw.to_batch(shard)
+    norm = None
+    if args.normalization != "NONE":
+        intercept = None
+        if index_maps is not None:
+            intercept = index_maps[shard].intercept_index
+        elif args.input_format == "LIBSVM":
+            intercept = raw.shard_dims[shard] - 1  # read_libsvm appends intercept last
+        norm = build_normalization(
+            args.normalization, stats["mean"], stats["variance"],
+            stats["max_magnitude"], intercept_index=intercept,
+            dtype=batch.labels.dtype,
+        )
+
+    box = None
+    if args.constraint_map and index_maps is not None:
+        with open(args.constraint_map) as f:
+            cmap = json.load(f)
+        d = raw.shard_dims[shard]
+        lower = np.full(d, -np.inf)
+        upper = np.full(d, np.inf)
+        imap = index_maps[shard]
+        for key, (lo, hi) in cmap.items():
+            idx = imap.get_index(key)
+            if idx >= 0:
+                lower[idx], upper[idx] = lo, hi
+        box = (jnp.asarray(lower, batch.labels.dtype), jnp.asarray(upper, batch.labels.dtype))
+
+    cfg = GLMOptimizationConfig(
+        optimizer=OptimizerConfig(
+            optimizer_type=OptimizerType(args.optimizer),
+            tolerance=args.tolerance,
+            max_iterations=args.max_iterations,
+            box_constraints=box,
+        ),
+        regularization=RegularizationContext(
+            args.regularization_type, args.elastic_net_alpha
+        ),
+        variance_type=args.variance_type,
+    )
+    weights = [float(w) for w in args.regularization_weights.split("|")]
+    trained = train_glm_grid(batch, args.task, cfg, weights, normalization=norm)
+    stage = "TRAINED"
+    logger.info("stage %s: %d models", stage, len(trained))
+
+    # ---- VALIDATE ------------------------------------------------------------
+    best = trained[-1]
+    if validation is not None:
+        specs = [e for e in args.evaluators.split(",") if e] or _default_evaluators(args.task)
+        suite = build_suite(specs, validation.labels, validation.weights)
+        vbatch = validation.to_batch(shard)
+        best, _ = select_best_model(trained, vbatch, suite)
+        stage = "VALIDATED"
+        logger.info("stage %s: best lambda=%s metrics=%s", stage, best.reg_weight, best.validation_metrics)
+
+    # ---- OUTPUT --------------------------------------------------------------
+    os.makedirs(args.output_dir, exist_ok=True)
+    summary = {
+        "stage": stage,
+        "models": [
+            {
+                "reg_weight": t.reg_weight,
+                "iterations": int(np.asarray(t.solver_result.iterations)),
+                "convergence_reason": int(np.asarray(t.solver_result.reason)),
+                "loss": float(np.asarray(t.solver_result.loss)),
+                "metrics": t.validation_metrics,
+            }
+            for t in trained
+        ],
+        "best_reg_weight": best.reg_weight,
+    }
+    with open(os.path.join(args.output_dir, "summary.json"), "w") as f:
+        json.dump(summary, f, indent=2, default=float)
+    for t in trained:
+        sub = os.path.join(args.output_dir, f"lambda-{t.reg_weight}")
+        os.makedirs(sub, exist_ok=True)
+        # text model output (IOUtils.writeModelsInText format: key\tvalue)
+        means = np.asarray(t.model.coefficients.means)
+        with open(os.path.join(sub, "model.txt"), "w") as f:
+            for i, v in enumerate(means):
+                key = index_maps[shard].get_feature_name(i) if index_maps else str(i)
+                f.write(f"{key}\t{v}\n")
+        if index_maps is not None:
+            save_glm(os.path.join(sub, "model.avro"), t.model, index_maps[shard])
+    logger.info("wrote %d models to %s", len(trained), args.output_dir)
+    return summary
+
+
+def _default_evaluators(task: str) -> List[str]:
+    t = task.lower()
+    if t in ("logistic_regression", "smoothed_hinge_loss_linear_svm"):
+        return ["AUC"]
+    if t == "poisson_regression":
+        return ["POISSON_LOSS"]
+    return ["RMSE"]
+
+
+def main():
+    run(sys.argv[1:])
+
+
+if __name__ == "__main__":
+    main()

@@ -67,6 +67,12 @@ def build_parser() -> argparse.ArgumentParser:
     )
     p.add_argument("--model-input-dir", default=None, help="warm-start GAME model")
     p.add_argument(
+        "--incremental-training",
+        action="store_true",
+        help="L2-regularize toward the warm-start model's means weighted by its "
+        "precisions (requires --model-input-dir)",
+    )
+    p.add_argument(
         "--partial-retrain-locked",
         default="",
         help="comma-separated coordinate names to lock (requires --model-input-dir)",
@@ -153,6 +159,11 @@ def run(argv: Optional[List[str]] = None) -> Dict:
     initial_model = None
     if args.model_input_dir:
         initial_model = load_game_model(args.model_input_dir, index_maps, task=args.task)
+    if args.incremental_training:
+        if initial_model is None:
+            raise SystemExit("--incremental-training requires --model-input-dir")
+        for cc in coords:
+            cc.regularize_by_prior = True
 
     evaluators = [e for e in args.evaluators.split(",") if e]
     estimator = GameEstimator(

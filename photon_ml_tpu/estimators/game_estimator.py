@@ -53,6 +53,9 @@ class CoordinateConfig:
     active_cap: Optional[int] = None
     active_lower_bound: int = 1
     normalization: Optional[NormalizationContext] = None
+    # incremental training: L2-regularize toward the warm-start model
+    # ("Regularize by Previous Model During Warm-Start Training")
+    regularize_by_prior: bool = False
 
     @property
     def is_random_effect(self) -> bool:
@@ -166,9 +169,13 @@ class GameEstimator:
         coords: Dict[str, Coordinate] = {}
         for cc in self.coordinate_configs:
             cfg = cc.config.with_reg_weight(reg_weights[cc.name])
+            prior = initial_models.get(cc.name) if cc.regularize_by_prior else None
             if cc.is_random_effect:
                 inner: Coordinate = RandomEffectCoordinate(
-                    dataset=datasets[cc.name], task=self.task, config=cfg
+                    dataset=datasets[cc.name],
+                    task=self.task,
+                    config=cfg,
+                    prior_model=prior,
                 )
             else:
                 inner = FixedEffectCoordinate(
@@ -176,6 +183,7 @@ class GameEstimator:
                     task=self.task,
                     config=cfg,
                     normalization=cc.normalization,
+                    prior_model=prior,
                 )
             if cc.name in self.partial_retrain_locked:
                 locked = initial_models.get(cc.name)

@@ -65,6 +65,8 @@ class FixedEffectCoordinate(Coordinate):
     config: GLMOptimizationConfig
     normalization: Optional[NormalizationContext] = None
     down_sampling_seed: int = 0
+    # incremental training: regularize toward this model instead of zero
+    prior_model: Optional[FixedEffectModel] = None
 
     def __post_init__(self):
         self.coordinate_id = self.dataset.coordinate_id
@@ -87,7 +89,10 @@ class FixedEffectCoordinate(Coordinate):
                 batch, self.task, self.config.down_sampling_rate, self.down_sampling_seed
             )
         problem = GLMProblem(
-            task=self.task, config=self.config, normalization=self.normalization
+            task=self.task,
+            config=self.config,
+            normalization=self.normalization,
+            prior=self.prior_model.model.coefficients if self.prior_model else None,
         )
         glm, result = problem.run(
             batch, initial_model=initial_model.model if initial_model else None
@@ -115,6 +120,8 @@ class RandomEffectCoordinate(Coordinate):
     dataset: RandomEffectDataset
     task: str
     config: GLMOptimizationConfig
+    # incremental training: per-entity prior means/precisions
+    prior_model: Optional[RandomEffectModel] = None
 
     def __post_init__(self):
         self.coordinate_id = self.dataset.coordinate_id
@@ -145,6 +152,18 @@ class RandomEffectCoordinate(Coordinate):
         else:
             w0 = jnp.zeros((E, S), dtype)
 
+        prior_mean = jnp.zeros((E, S), dtype)
+        prior_prec = jnp.ones((E, S), dtype)
+        if self.prior_model is not None:
+            prior_mean = _project_model_values(
+                self.dataset, self.prior_model, self.prior_model.coef_values, dtype
+            )
+            if self.prior_model.variances is not None:
+                var = _project_model_values(
+                    self.dataset, self.prior_model, self.prior_model.variances, dtype
+                )
+                prior_prec = 1.0 / jnp.maximum(var, 1e-12)
+
         cfg = self.config
         solver_cfg = cfg.solver_config()
         results = _train_blocks(
@@ -153,6 +172,8 @@ class RandomEffectCoordinate(Coordinate):
             offsets,
             blocks.weights,
             w0,
+            prior_mean,
+            prior_prec,
             task=self.task,
             l2=cfg.regularization.l2_weight(cfg.reg_weight),
             l1=solver_cfg.l1_weight,
@@ -190,11 +211,12 @@ class RandomEffectCoordinate(Coordinate):
         return model.score_ell_rows(row_entity, self.dataset.ell_idx, self.dataset.ell_val)
 
 
-def _initial_subspace_coefficients(
-    dataset: RandomEffectDataset, model: RandomEffectModel, dtype
+def _project_model_values(
+    dataset: RandomEffectDataset, model: RandomEffectModel, values, dtype
 ) -> Array:
-    """Project a RandomEffectModel into this dataset's entity/subspace layout
-    (warm start across coordinate-descent iterations / lambda sweeps)."""
+    """Project per-entity values stored in ``model``'s (entity, support)
+    layout into this dataset's entity/subspace block layout (model projection,
+    reference ModelProjection.scala:30-85)."""
     blocks = dataset.blocks
     E, S = blocks.proj_cols.shape
     if (
@@ -203,7 +225,7 @@ def _initial_subspace_coefficients(
         and np.array_equal(np.asarray(model.coef_indices), np.asarray(blocks.proj_cols))
         and list(map(str, model.entity_ids)) == list(map(str, dataset.entity_ids))
     ):
-        return jnp.asarray(model.coef_values, dtype)  # same layout: reuse directly
+        return jnp.asarray(values, dtype)  # same layout: reuse directly
     # general path: dense per-entity gather on host
     dim = int(
         max(
@@ -212,7 +234,12 @@ def _initial_subspace_coefficients(
         )
         + 1
     )
-    dense = model.dense_coefficients(dim)
+    vals = np.asarray(values)
+    idx = np.asarray(model.coef_indices)
+    dense = np.zeros((model.num_entities, dim))
+    for e in range(model.num_entities):
+        m = idx[e] >= 0
+        dense[e, idx[e][m]] = vals[e][m]
     rows = model.rows_for(dataset.entity_ids)
     w0 = np.zeros((E, S))
     pc = np.asarray(blocks.proj_cols)
@@ -223,6 +250,13 @@ def _initial_subspace_coefficients(
         m = pc[e] >= 0
         w0[e, m] = dense[r, pc[e][m]]
     return jnp.asarray(w0, dtype)
+
+
+def _initial_subspace_coefficients(
+    dataset: RandomEffectDataset, model: RandomEffectModel, dtype
+) -> Array:
+    """Warm-start coefficients in this dataset's block layout."""
+    return _project_model_values(dataset, model, model.coef_values, dtype)
 
 
 @partial(
@@ -245,6 +279,8 @@ def _train_blocks(
     offsets: Array,
     weights: Array,
     w0: Array,  # [E, S]
+    prior_mean: Array,  # [E, S]; zeros = plain L2
+    prior_prec: Array,  # [E, S]; ones = plain L2
     *,
     task: str,
     l2: float,
@@ -260,14 +296,16 @@ def _train_blocks(
     loss = get_loss(task)
     S = features.shape[-1]
 
-    def solve_one(feat, y, off, wt, w0_e):
+    def solve_one(feat, y, off, wt, w0_e, pm_e, pp_e):
         batch = LabeledBatch(
             features=FeatureMatrix(dim=S, dense=feat),
             labels=y,
             offsets=off,
             weights=wt,
         )
-        obj = GLMObjective(loss=loss, batch=batch, l2=l2)
+        obj = GLMObjective(
+            loss=loss, batch=batch, l2=l2, prior_mean=pm_e, prior_precision=pp_e
+        )
         loss_tol, grad_tol = abs_tolerances(obj.value_and_grad, w0_e, tolerance)
         if optimizer_type == "TRON":
             return solve_tron(
@@ -290,7 +328,9 @@ def _train_blocks(
             l1_weight=l1,
         )
 
-    return jax.vmap(solve_one)(features, labels, offsets, weights, w0)
+    return jax.vmap(solve_one)(
+        features, labels, offsets, weights, w0, prior_mean, prior_prec
+    )
 
 
 @dataclasses.dataclass

@@ -59,13 +59,29 @@ class GLMProblem:
     task: str
     config: GLMOptimizationConfig
     normalization: Optional[NormalizationContext] = None
+    # incremental training: L2 centered on a prior model's means, weighted by
+    # its precisions (README.md:102-103 "Regularize by Previous Model")
+    prior: Optional[Coefficients] = None
 
     def objective(self, batch: LabeledBatch) -> GLMObjective:
+        prior_mean = prior_precision = None
+        if self.prior is not None:
+            dtype = batch.labels.dtype
+            prior_mean = jnp.asarray(self.prior.means, dtype)
+            if self.normalization is not None:
+                prior_mean = self.normalization.model_to_transformed_space(prior_mean)
+            if self.prior.variances is not None:
+                var = jnp.asarray(self.prior.variances, dtype)
+                prior_precision = 1.0 / jnp.maximum(var, 1e-12)
+            else:
+                prior_precision = jnp.ones_like(prior_mean)
         return GLMObjective(
             loss=get_loss(self.task),
             batch=batch,
             l2=self.config.regularization.l2_weight(self.config.reg_weight),
             norm=self.normalization,
+            prior_mean=prior_mean,
+            prior_precision=prior_precision,
         )
 
     def run(

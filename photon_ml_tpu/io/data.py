@@ -80,7 +80,8 @@ class RawDataset:
 
         from ..ops.features import batch_from_coo, batch_from_dense
 
-        dtype = dtype or jnp.float32
+        # default to JAX's default float (f32 on TPU, f64 under x64 configs)
+        dtype = dtype or jnp.asarray(0.0).dtype
         rows, cols, vals = self.shard_coo[shard]
         d = self.shard_dims[shard]
         if layout == "auto":

@@ -1,0 +1,88 @@
+"""Input data validation.
+
+Reference: photon-client .../data/DataValidators.scala (405 lines): per-task
+row checks — finite features/offsets/weights, label ranges (binary labels in
+{0,1}/{-1,1}, non-negative Poisson counts), nonzero weights — in FULL (all
+rows) or SAMPLE mode, failing the job with a count of offending rows.
+"""
+
+from __future__ import annotations
+
+import logging
+from typing import List, Sequence
+
+import numpy as np
+
+from .data import RawDataset
+
+logger = logging.getLogger("photon_ml_tpu")
+
+VALIDATE_FULL = "VALIDATE_FULL"
+VALIDATE_SAMPLE = "VALIDATE_SAMPLE"
+VALIDATE_DISABLED = "DISABLED"
+
+
+class DataValidationError(ValueError):
+    pass
+
+
+def _sample(mask_len: int, mode: str, rng_seed: int = 0) -> np.ndarray:
+    if mode == VALIDATE_FULL:
+        return np.arange(mask_len)
+    rng = np.random.default_rng(rng_seed)
+    take = max(1, mask_len // 100)
+    return rng.choice(mask_len, size=min(take, mask_len), replace=False)
+
+
+def validate_dataset(
+    raw: RawDataset,
+    task: str,
+    mode: str = VALIDATE_FULL,
+) -> None:
+    """Raise DataValidationError listing every failed check
+    (DataValidators.sanityCheckDataFrameForTraining semantics)."""
+    if mode == VALIDATE_DISABLED:
+        return
+    rows = _sample(raw.n_rows, mode)
+    problems: List[str] = []
+
+    labels = raw.labels[rows]
+    if not np.all(np.isfinite(labels)):
+        problems.append(f"{np.sum(~np.isfinite(labels))} non-finite labels")
+    t = task.lower()
+    if t in ("logistic_regression", "smoothed_hinge_loss_linear_svm"):
+        ok = np.isin(labels, (0.0, 1.0, -1.0))
+        if not np.all(ok):
+            problems.append(
+                f"{np.sum(~ok)} labels outside {{0,1,-1}} for binary task {task}"
+            )
+    elif t == "poisson_regression":
+        if np.any(labels < 0):
+            problems.append(f"{np.sum(labels < 0)} negative labels for Poisson")
+
+    if not np.all(np.isfinite(raw.offsets[rows])):
+        problems.append("non-finite offsets")
+    w = raw.weights[rows]
+    if not np.all(np.isfinite(w)) or np.any(w < 0):
+        problems.append("non-finite or negative weights")
+    if np.all(w == 0):
+        problems.append("all sampled weights are zero")
+
+    row_set = set(rows.tolist())
+    for shard, (r, c, v) in raw.shard_coo.items():
+        if mode == VALIDATE_FULL:
+            bad = ~np.isfinite(v)
+        else:
+            in_sample = np.isin(r, rows)
+            bad = in_sample & ~np.isfinite(v)
+        if np.any(bad):
+            problems.append(f"shard {shard}: {np.sum(bad)} non-finite feature values")
+        d = raw.shard_dims[shard]
+        if len(c) and (c.min() < 0 or c.max() >= d):
+            problems.append(f"shard {shard}: feature index out of range [0, {d})")
+
+    if problems:
+        raise DataValidationError(
+            "input data failed validation: " + "; ".join(problems)
+        )
+    logger.info("data validation passed (%s, %d rows checked)", mode, len(rows))

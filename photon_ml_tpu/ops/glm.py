@@ -59,9 +59,23 @@ class GLMObjective:
     # (DistributedOptimizationProblem.updateRegularizationWeight:64-75)
     l2: float = 0.0
     norm: Optional[NormalizationContext] = None
+    # Incremental training ("Regularize by Previous Model During Warm-Start
+    # Training", reference README.md:102-103): the L2 penalty centers on a
+    # prior model's means and weights per-coefficient by the prior precision
+    # (1/variance). With prior_mean=0 / prior_precision=1 this is plain L2.
+    prior_mean: Optional[Array] = None
+    prior_precision: Optional[Array] = None
 
     def _norm(self) -> NormalizationContext:
         return self.norm if self.norm is not None else identity_normalization()
+
+    def _reg_delta(self, coef: Array) -> Array:
+        return coef if self.prior_mean is None else coef - self.prior_mean
+
+    def _precision(self, like: Array) -> Array:
+        return (
+            jnp.ones_like(like) if self.prior_precision is None else self.prior_precision
+        )
 
     def _margins(self, coef: Array) -> Tuple[Array, Array]:
         """Returns (margins, effective_coef)."""
@@ -88,8 +102,10 @@ class GLMObjective:
             grad = grad - norm.shifts * jnp.sum(wdz)
         if norm.factors is not None:
             grad = grad * norm.factors
-        value = value + 0.5 * self.l2 * jnp.dot(coef, coef)
-        grad = grad + self.l2 * coef
+        delta = self._reg_delta(coef)
+        prec = self._precision(coef)
+        value = value + 0.5 * self.l2 * jnp.dot(delta, prec * delta)
+        grad = grad + self.l2 * prec * delta
         return value, grad
 
     def _d2z_weights(self, coef: Array) -> Array:
@@ -115,7 +131,7 @@ class GLMObjective:
             hv = hv - norm.shifts * jnp.sum(c)
         if norm.factors is not None:
             hv = hv * norm.factors
-        hv = hv + self.l2 * v
+        hv = hv + self.l2 * self._precision(v) * v
         return hv
 
     def hessian_diagonal(self, coef: Array) -> Array:
@@ -134,7 +150,7 @@ class GLMObjective:
             diag = s2 - 2.0 * norm.shifts * s1 + norm.shifts**2 * s0
         if norm.factors is not None:
             diag = diag * norm.factors**2
-        diag = diag + self.l2
+        diag = diag + self.l2 * self._precision(diag)
         return diag
 
     def hessian_matrix(self, coef: Array) -> Array:
@@ -150,7 +166,7 @@ class GLMObjective:
         if norm.factors is not None:
             x = x * norm.factors[None, :]
         h = x.T @ (c[:, None] * x)
-        h = h + self.l2 * jnp.eye(h.shape[0], dtype=h.dtype)
+        h = h + self.l2 * jnp.diag(self._precision(jnp.diagonal(h)))
         return h
 
 

@@ -1,0 +1,138 @@
+"""Legacy GLM driver end-to-end tests on reference fixtures (the
+DriverIntegTest role): staged pipeline, lambda grid, constraints, validators."""
+
+import json
+import os
+
+import numpy as np
+import pytest
+
+from photon_ml_tpu.cli import glm as glm_driver
+from photon_ml_tpu.io.validators import DataValidationError, validate_dataset
+from photon_ml_tpu.io.data import RawDataset
+
+HEART = "/root/reference/photon-client/src/integTest/resources/DriverIntegTest/input/heart.avro"
+HEART_VAL = "/root/reference/photon-client/src/integTest/resources/DriverIntegTest/input/heart_validation.avro"
+HEART_TXT = "/root/reference/photon-client/src/integTest/resources/DriverIntegTest/input/heart_validation.txt"
+
+needs_fixture = pytest.mark.skipif(
+    not os.path.exists(HEART), reason="reference fixtures not mounted"
+)
+
+
+@needs_fixture
+def test_legacy_driver_avro(tmp_path):
+    out = str(tmp_path / "out")
+    summary = glm_driver.run(
+        [
+            "--input-data", HEART,
+            "--validation-data", HEART_VAL,
+            "--task", "logistic_regression",
+            "--optimizer", "TRON",
+            "--max-iterations", "30",
+            "--regularization-type", "L2",
+            "--regularization-weights", "0.1|1|10",
+            "--normalization", "STANDARDIZATION",
+            "--evaluators", "AUC",
+            "--output-dir", out,
+        ]
+    )
+    assert summary["stage"] == "VALIDATED"
+    assert len(summary["models"]) == 3
+    aucs = [m["metrics"]["AUC"] for m in summary["models"]]
+    assert max(aucs) > 0.8
+    # best model files exist (text + avro)
+    best = summary["best_reg_weight"]
+    assert os.path.exists(os.path.join(out, f"lambda-{best}", "model.txt"))
+    assert os.path.exists(os.path.join(out, f"lambda-{best}", "model.avro"))
+    with open(os.path.join(out, f"lambda-{best}", "model.txt")) as f:
+        lines = f.read().strip().split("\n")
+    assert len(lines) == 14  # 13 features + intercept
+
+
+@needs_fixture
+def test_legacy_driver_libsvm(tmp_path):
+    out = str(tmp_path / "out")
+    summary = glm_driver.run(
+        [
+            "--input-data", HEART_TXT,
+            "--input-format", "LIBSVM",
+            "--task", "logistic_regression",
+            "--regularization-type", "L2",
+            "--regularization-weights", "1",
+            "--normalization", "STANDARDIZATION",
+            "--output-dir", out,
+        ]
+    )
+    assert summary["stage"] == "TRAINED"
+    assert summary["models"][0]["convergence_reason"] in (3, 4)
+
+
+@needs_fixture
+def test_legacy_driver_box_constraints(tmp_path):
+    # constrain every feature into [-0.01, 0.01]
+    from photon_ml_tpu.io import read_avro_dataset, FeatureShardConfig
+
+    _, imaps = read_avro_dataset(HEART, {"global": FeatureShardConfig(("features",))})
+    cmap = {k: [-0.01, 0.01] for k, _ in imaps["global"].items()}
+    cpath = str(tmp_path / "constraints.json")
+    with open(cpath, "w") as f:
+        json.dump(cmap, f)
+    out = str(tmp_path / "out")
+    glm_driver.run(
+        [
+            "--input-data", HEART,
+            "--task", "logistic_regression",
+            "--optimizer", "LBFGSB",
+            "--regularization-weights", "1",
+            "--constraint-map", cpath,
+            "--output-dir", out,
+        ]
+    )
+    with open(os.path.join(out, "lambda-1.0", "model.txt")) as f:
+        vals = [float(line.split("\t")[1]) for line in f.read().strip().split("\n")]
+    assert np.all(np.abs(vals) <= 0.01 + 1e-9)
+
+
+def test_validators_reject_bad_labels():
+    raw = RawDataset(
+        n_rows=3,
+        labels=np.asarray([0.0, 2.0, 1.0]),
+        offsets=np.zeros(3),
+        weights=np.ones(3),
+        shard_coo={"global": (np.asarray([0]), np.asarray([0]), np.asarray([1.0]))},
+        shard_dims={"global": 2},
+        id_tags={},
+    )
+    with pytest.raises(DataValidationError, match="labels outside"):
+        validate_dataset(raw, "logistic_regression")
+    # fine for linear regression
+    validate_dataset(raw, "linear_regression")
+
+
+def test_validators_reject_nonfinite_features():
+    raw = RawDataset(
+        n_rows=2,
+        labels=np.asarray([0.0, 1.0]),
+        offsets=np.zeros(2),
+        weights=np.ones(2),
+        shard_coo={"global": (np.asarray([0]), np.asarray([0]), np.asarray([np.nan]))},
+        shard_dims={"global": 2},
+        id_tags={},
+    )
+    with pytest.raises(DataValidationError, match="non-finite feature"):
+        validate_dataset(raw, "linear_regression")
+
+
+def test_validators_poisson_negative_labels():
+    raw = RawDataset(
+        n_rows=2,
+        labels=np.asarray([-1.0, 1.0]),
+        offsets=np.zeros(2),
+        weights=np.ones(2),
+        shard_coo={"global": (np.asarray([0]), np.asarray([0]), np.asarray([1.0]))},
+        shard_dims={"global": 2},
+        id_tags={},
+    )
+    with pytest.raises(DataValidationError, match="negative labels"):
+        validate_dataset(raw, "poisson_regression")
