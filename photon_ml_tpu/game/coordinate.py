@@ -166,14 +166,7 @@ class RandomEffectCoordinate(Coordinate):
 
         cfg = self.config
         solver_cfg = cfg.solver_config()
-        results = _train_blocks(
-            blocks.features,
-            blocks.labels,
-            offsets,
-            blocks.weights,
-            w0,
-            prior_mean,
-            prior_prec,
+        solver_kwargs = dict(
             task=self.task,
             l2=cfg.regularization.l2_weight(cfg.reg_weight),
             l1=solver_cfg.l1_weight,
@@ -184,6 +177,33 @@ class RandomEffectCoordinate(Coordinate):
             max_cg_iterations=solver_cfg.max_cg_iterations,
             max_improvement_failures=solver_cfg.max_improvement_failures,
         )
+        segments = _size_buckets(self.dataset)
+        if segments is None:
+            results = _train_blocks(
+                blocks.features, blocks.labels, offsets, blocks.weights,
+                w0, prior_mean, prior_prec, **solver_kwargs,
+            )
+        else:
+            # Size-bucketed solves: entities are sorted by descending row
+            # count, so each (K, S)-rounded bucket is a contiguous block-row
+            # segment; solving per bucket avoids every small entity paying
+            # the padding of the largest (RandomEffectDatasetPartitioner's
+            # size-awareness, re-purposed for vmap lane economy).
+            parts = []
+            for start, end, kb, sb in segments:
+                parts.append(
+                    _train_blocks(
+                        blocks.features[start:end, :kb, :sb],
+                        blocks.labels[start:end, :kb],
+                        offsets[start:end, :kb],
+                        blocks.weights[start:end, :kb],
+                        w0[start:end, :sb],
+                        prior_mean[start:end, :sb],
+                        prior_prec[start:end, :sb],
+                        **solver_kwargs,
+                    )
+                )
+            results = _concat_results(parts, S)
         w_sub = results.coefficients  # [E, S]
         valid = blocks.proj_cols >= 0
         model = RandomEffectModel(
@@ -209,6 +229,57 @@ class RandomEffectCoordinate(Coordinate):
             mapped = np.where(re_np >= 0, block_to_model[np.maximum(re_np, 0)], -1)
             row_entity = jnp.asarray(mapped.astype(np.int32))
         return model.score_ell_rows(row_entity, self.dataset.ell_idx, self.dataset.ell_val)
+
+
+def _size_buckets(dataset: RandomEffectDataset, min_dim: int = 8):
+    """Contiguous entity segments with power-of-2-rounded (K, S) block shapes.
+
+    Returns [(start, end, K_b, S_b)], or None when per-entity stats are
+    unavailable or bucketing cannot shrink anything. Rounding to powers of two
+    (floored at ``min_dim``) bounds the number of distinct compiled solver
+    shapes at O(log^2) while removing the bulk of the padding FLOPs.
+    """
+    counts = dataset.entity_counts
+    svec = dataset.entity_subspace_dims
+    if counts is None or svec is None or len(counts) == 0:
+        return None
+    E, K, S = dataset.blocks.features.shape
+
+    def pow2_ceil(x):
+        return 1 << int(max(x, 1) - 1).bit_length()
+
+    kb_of = np.minimum([max(pow2_ceil(c), min_dim) for c in counts], K)
+    # counts are non-increasing, so equal-K runs are contiguous
+    segments = []
+    start = 0
+    for i in range(1, E + 1):
+        if i == E or kb_of[i] != kb_of[start]:
+            sb = min(max(pow2_ceil(int(svec[start:i].max())), min_dim), S)
+            segments.append((start, i, int(kb_of[start]), int(sb)))
+            start = i
+    if len(segments) == 1 and segments[0][2] >= K and segments[0][3] >= S:
+        return None
+    return segments
+
+
+def _concat_results(parts, S: int) -> SolverResult:
+    """Stitch per-bucket vmapped SolverResults back into entity order,
+    zero-padding coefficients/gradients to the global subspace dim."""
+
+    def pad_cols(a):
+        if a.shape[-1] == S:
+            return a
+        return jnp.pad(a, ((0, 0), (0, S - a.shape[-1])))
+
+    return SolverResult(
+        coefficients=jnp.concatenate([pad_cols(p.coefficients) for p in parts]),
+        loss=jnp.concatenate([p.loss for p in parts]),
+        gradient=jnp.concatenate([pad_cols(p.gradient) for p in parts]),
+        iterations=jnp.concatenate([p.iterations for p in parts]),
+        reason=jnp.concatenate([p.reason for p in parts]),
+        loss_history=jnp.concatenate([p.loss_history for p in parts]),
+        grad_norm_history=jnp.concatenate([p.grad_norm_history for p in parts]),
+    )
 
 
 def _project_model_values(

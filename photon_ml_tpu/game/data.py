@@ -98,6 +98,12 @@ class RandomEffectDataset:
     ell_idx: Array  # i32[n, F]
     ell_val: Array  # f[n, F]
     passive_rows: np.ndarray  # i64[*] rows not in any active block (info only)
+    # host-side per-entity stats (entities are size-sorted descending), used
+    # to bucket the vmapped solver by block size so small entities don't pay
+    # the padding of the largest (the TPU analogue of the reference's
+    # size-aware partitioning, RandomEffectDatasetPartitioner.scala:117-180)
+    entity_counts: Optional[np.ndarray] = None  # i64[E] active rows per entity
+    entity_subspace_dims: Optional[np.ndarray] = None  # i64[E] real S per entity
 
     @property
     def num_entities(self) -> int:
@@ -107,7 +113,7 @@ class RandomEffectDataset:
 def _hash64(a: np.ndarray, seed: int) -> np.ndarray:
     """Deterministic splitmix64-style mix of row ids (the reservoir priority;
     plays the role of byteswap64(hash ^ uniqueId), RandomEffectDataset.scala:483-491)."""
-    x = (a.astype(np.uint64) + np.uint64(seed) * np.uint64(0x9E3779B97F4A7C15))
+    x = a.astype(np.uint64) + np.uint64((seed * 0x9E3779B97F4A7C15) & 0xFFFFFFFFFFFFFFFF)
     x = (x ^ (x >> np.uint64(30))) * np.uint64(0xBF58476D1CE4E5B9)
     x = (x ^ (x >> np.uint64(27))) * np.uint64(0x94D049BB133111EB)
     return x ^ (x >> np.uint64(31))
@@ -167,7 +173,13 @@ def build_random_effect_dataset(
     rows, cols, vals = raw.shard_coo[feature_shard]
 
     # --- group rows by entity ------------------------------------------------
-    uniq, inv = np.unique(ids.astype(str), return_inverse=True)
+    # unique in the ids' native dtype (string conversion of millions of int
+    # ids costs more than the whole rest of the build); entity ids are
+    # stringified only in the E-sized entity_ids output below
+    ids_arr = np.asarray(ids)
+    if ids_arr.dtype == object:
+        ids_arr = ids_arr.astype(str)
+    uniq, inv = np.unique(ids_arr, return_inverse=True)
     counts = np.bincount(inv, minlength=len(uniq))
 
     kept_mask = counts >= active_lower_bound
@@ -206,10 +218,9 @@ def build_random_effect_dataset(
 
     active_rows_np = np.full((E, K), -1, dtype=np.int64)
     weight_scale = np.ones(E)
-    for e in range(E_real):
-        cnt = counts[kept_entities[e]]
-        if cnt > cap:
-            weight_scale[e] = cnt / cap
+    if E_real:
+        counts_kept = counts[kept_entities].astype(np.float64)
+        weight_scale[:E_real] = np.where(counts_kept > cap, counts_kept / cap, 1.0)
     sel = np.nonzero(is_active)[0]
     active_rows_np[sorted_entity[sel], rank[sel]] = sorted_rows[sel]
 
@@ -218,42 +229,45 @@ def build_random_effect_dataset(
     # --- ELL features for all rows (scoring path) ----------------------------
     ell_idx_np, ell_val_np = _rows_to_ell(rows, cols, vals, n)
 
-    # --- per-entity subspace projection (LinearSubspaceProjector) ------------
-    # vectorized inner ops; one short numpy pass per entity
-    S = 1
-    per_entity_cols: List[np.ndarray] = []
-    for e in range(E_real):
-        r = active_rows_np[e]
-        r = r[r >= 0]
-        c = np.unique(ell_idx_np[r][ell_val_np[r] != 0])
-        per_entity_cols.append(c)  # np.unique output is sorted
-        S = max(S, len(c))
-    proj_cols_np = np.full((E, S), -1, dtype=np.int32)
-    for e in range(E_real):
-        c = per_entity_cols[e]
-        proj_cols_np[e, : len(c)] = c
+    # --- per-entity subspace projection + dense blocks, fully vectorized -----
+    # (reference pipeline: RandomEffectDataset.generateLinearSubspaceProjectors
+    # + project, RandomEffectDataset.scala:255-360; the reference shuffled
+    # per-entity iterables through Spark — here it is one sorted/segmented
+    # numpy pass over the active nnz, no per-entity Python loop, so millions
+    # of entities build in seconds.)
+    ae = sorted_entity[sel]  # block row per active sample        [A]
+    ak = rank[sel]  # slot within block                           [A]
+    ar = sorted_rows[sel]  # global sample row                    [A]
 
-    # --- dense projected blocks (vectorized per entity) ----------------------
-    feats = np.zeros((E, K, S), dtype=np.float64)
     labels_b = np.zeros((E, K))
     offsets_b = np.zeros((E, K))
     weights_b = np.zeros((E, K))
-    for e in range(E_real):
-        ks = np.nonzero(active_rows_np[e] >= 0)[0]
-        r = active_rows_np[e, ks]
-        labels_b[e, ks] = raw.labels[r]
-        offsets_b[e, ks] = raw.offsets[r]
-        weights_b[e, ks] = raw.weights[r] * weight_scale[e]
-        cols_e = per_entity_cols[e]
-        if len(cols_e) == 0:
-            continue
-        fi = ell_idx_np[r]  # [k, F]
-        fv = ell_val_np[r]
-        pos = np.searchsorted(cols_e, fi)  # [k, F]
-        pos_c = np.clip(pos, 0, len(cols_e) - 1)
-        hit = (cols_e[pos_c] == fi) & (fv != 0.0)
-        kk, ff = np.nonzero(hit)
-        feats[e, ks[kk], pos_c[kk, ff]] = fv[kk, ff]
+    labels_b[ae, ak] = raw.labels[ar]
+    offsets_b[ae, ak] = raw.offsets[ar]
+    weights_b[ae, ak] = raw.weights[ar] * weight_scale[ae]
+
+    d_shard = raw.shard_dims[feature_shard]
+    fi = ell_idx_np[ar]  # [A, F] global cols of active rows
+    fv = ell_val_np[ar]  # [A, F]
+    nz = fv != 0.0
+    # unique (entity, col) pairs, entity-major and col-ascending: exactly the
+    # per-entity sorted active-index union of LinearSubspaceProjector.scala:37-90
+    keys = ae[:, None].astype(np.int64) * d_shard + fi  # [A, F]
+    uniq_keys = np.unique(keys[nz])
+    ent_of_key = (uniq_keys // d_shard).astype(np.int64)
+    col_of_key = (uniq_keys % d_shard).astype(np.int32)
+    per_entity_s = np.bincount(ent_of_key, minlength=E)
+    S = max(int(per_entity_s.max()) if len(uniq_keys) else 1, 1)
+    key_starts = np.concatenate([[0], np.cumsum(per_entity_s)[:-1]])
+    pos_within = np.arange(len(uniq_keys)) - key_starts[ent_of_key]
+    proj_cols_np = np.full((E, S), -1, dtype=np.int32)
+    proj_cols_np[ent_of_key, pos_within] = col_of_key
+
+    feats = np.zeros((E, K, S), dtype=np.float64)
+    aa, ff = np.nonzero(nz)  # active nnz coordinates (row-major, like the
+    # assignment order of the loop implementation)
+    loc = np.searchsorted(uniq_keys, keys[aa, ff]) - key_starts[ae[aa]]
+    feats[ae[aa], ak[aa], loc] = fv[aa, ff]
 
     blocks = EntityBlocks(
         features=jnp.asarray(feats, dtype),
@@ -265,9 +279,10 @@ def build_random_effect_dataset(
     )
 
     row_entity = np.where(entity_of_row >= 0, entity_of_row, -1).astype(np.int32)
+    kept_ids = uniq[kept_entities].astype(str)
     entity_ids = np.concatenate(
-        [uniq[kept_entities], np.asarray([f"__pad{i}" for i in range(E - E_real)], dtype=object)]
-    ) if E > E_real else uniq[kept_entities]
+        [kept_ids, np.asarray([f"__pad{i}" for i in range(E - E_real)], dtype=object)]
+    ) if E > E_real else kept_ids
 
     return RandomEffectDataset(
         coordinate_id=coordinate_id,
@@ -279,4 +294,6 @@ def build_random_effect_dataset(
         ell_idx=jnp.asarray(ell_idx_np),
         ell_val=jnp.asarray(ell_val_np, dtype),
         passive_rows=passive,
+        entity_counts=np.sum(active_rows_np >= 0, axis=1).astype(np.int64),
+        entity_subspace_dims=per_entity_s.astype(np.int64),
     )

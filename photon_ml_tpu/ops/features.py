@@ -7,8 +7,20 @@ offset, weight). On TPU the batch is a struct-of-arrays in one of two layouts:
   small/medium d and for per-entity projected subspace blocks.
 - ``ELL (padded sparse)``: ``idx[n, k] i32`` + ``val[n, k] f32`` with per-row
   padding (idx=0, val=0). Margins are a gather + row-sum; gradient
-  accumulation is a scatter-add (segment sum). Right layout for very wide,
-  very sparse feature spaces where densification is impossible.
+  accumulation is a scatter-add (segment sum). Right layout for wide, sparse
+  feature spaces where densification is impossible.
+- ``sorted COO``: flat ``(coo_cols, coo_rows, coo_vals)`` triplets sorted by
+  column. The layout for HUGE d (millions+): the gradient scatter-add runs
+  with ``indices_are_sorted`` (XLA's only non-serial scatter path on TPU),
+  and the column axis partitions contiguously for model-axis sharding
+  (see parallel/sparse.py). Measured on v5e: unstructured gather/scatter is
+  ~7 cycles/element regardless of layout (no HBM cache, no vectorized
+  VMEM gather pre-SparseCore), so single-chip sparse throughput is
+  serialization-bound; the design answer is to *divide* that cost across
+  devices by (data x model) tiling, not to chase a magic kernel. A Pallas
+  route was measured and rejected: tpu.dynamic_gather only shuffles within
+  one (8, 128) vreg, so large-table gathers cannot vectorize on this
+  generation.
 
 Zero-valued padding entries contribute nothing to margins or gradients, so no
 separate mask is needed; padded *rows* carry weight 0.
@@ -32,22 +44,49 @@ Array = jax.Array
 @jax.tree_util.register_dataclass
 @dataclasses.dataclass(frozen=True)
 class FeatureMatrix:
-    """A batch of feature vectors, dense ``[n, d]`` or padded-sparse (ELL).
+    """A batch of feature vectors: dense ``[n, d]``, padded-sparse (ELL), or
+    column-sorted COO.
 
-    Exactly one of ``dense`` or (``idx``, ``val``) is set. ``dim`` is the
-    feature-space dimension d (static so jitted shapes are known).
+    Exactly one of ``dense`` / (``idx``, ``val``) / (``coo_cols``,
+    ``coo_rows``, ``coo_vals``) is set. ``dim`` is the feature-space
+    dimension d (static so jitted shapes are known); ``coo_n_rows`` is the
+    static row count for the COO layout (not derivable from array shapes).
     """
 
     dim: int = dataclasses.field(metadata=dict(static=True))
     dense: Optional[Array] = None
     idx: Optional[Array] = None
     val: Optional[Array] = None
+    coo_cols: Optional[Array] = None  # i32[m], sorted ascending (pad: dim-1)
+    coo_rows: Optional[Array] = None  # i32[m] (pad: 0)
+    coo_vals: Optional[Array] = None  # f[m] (pad: 0)
+    coo_n_rows: int = dataclasses.field(default=0, metadata=dict(static=True))
 
     def __post_init__(self):
-        if (self.dense is None) == (self.idx is None):
-            raise ValueError("exactly one of dense / (idx, val) must be provided")
+        n_set = (
+            (self.dense is not None)
+            + (self.idx is not None)
+            + (self.coo_cols is not None)
+        )
+        if n_set != 1:
+            raise ValueError(
+                "exactly one of dense / (idx, val) / (coo_cols, coo_rows, coo_vals)"
+                " must be provided"
+            )
         if self.idx is not None and self.val is None:
-            raise ValueError("sparse layout requires both idx and val")
+            raise ValueError("ELL layout requires both idx and val")
+        if self.coo_cols is not None and (
+            self.coo_rows is None or self.coo_vals is None
+        ):
+            raise ValueError("COO layout requires coo_cols, coo_rows and coo_vals")
+
+    @property
+    def layout(self) -> str:
+        if self.dense is not None:
+            return "dense"
+        if self.idx is not None:
+            return "ell"
+        return "coo"
 
     @property
     def is_dense(self) -> bool:
@@ -55,43 +94,67 @@ class FeatureMatrix:
 
     @property
     def n_rows(self) -> int:
-        return self.dense.shape[0] if self.is_dense else self.idx.shape[0]
+        if self.dense is not None:
+            return self.dense.shape[0]
+        if self.idx is not None:
+            return self.idx.shape[0]
+        return self.coo_n_rows
 
     def matvec(self, w: Array) -> Array:
         """x @ w -> [n]."""
-        if self.is_dense:
+        if self.dense is not None:
             return self.dense @ w
-        return jnp.sum(self.val * jnp.take(w, self.idx, axis=0), axis=1)
+        if self.idx is not None:
+            return jnp.sum(self.val * jnp.take(w, self.idx, axis=0), axis=1)
+        wv = jnp.take(w, self.coo_cols) * self.coo_vals
+        return jnp.zeros(self.coo_n_rows, dtype=wv.dtype).at[self.coo_rows].add(wv)
 
     def rmatvec(self, c: Array) -> Array:
         """x^T @ c -> [d]: the gradient-accumulation kernel."""
-        if self.is_dense:
+        if self.dense is not None:
             return self.dense.T @ c
-        contrib = c[:, None] * self.val
-        return jnp.zeros(self.dim, dtype=contrib.dtype).at[self.idx.reshape(-1)].add(
-            contrib.reshape(-1)
+        if self.idx is not None:
+            contrib = c[:, None] * self.val
+            return jnp.zeros(self.dim, dtype=contrib.dtype).at[
+                self.idx.reshape(-1)
+            ].add(contrib.reshape(-1))
+        contrib = jnp.take(c, self.coo_rows) * self.coo_vals
+        return jnp.zeros(self.dim, dtype=contrib.dtype).at[self.coo_cols].add(
+            contrib, indices_are_sorted=True
         )
 
     def sq_rmatvec(self, c: Array) -> Array:
         """(x*x)^T @ c -> [d]: Hessian-diagonal accumulation."""
-        if self.is_dense:
+        if self.dense is not None:
             return (self.dense * self.dense).T @ c
-        contrib = c[:, None] * self.val * self.val
-        return jnp.zeros(self.dim, dtype=contrib.dtype).at[self.idx.reshape(-1)].add(
-            contrib.reshape(-1)
+        if self.idx is not None:
+            contrib = c[:, None] * self.val * self.val
+            return jnp.zeros(self.dim, dtype=contrib.dtype).at[
+                self.idx.reshape(-1)
+            ].add(contrib.reshape(-1))
+        contrib = jnp.take(c, self.coo_rows) * self.coo_vals * self.coo_vals
+        return jnp.zeros(self.dim, dtype=contrib.dtype).at[self.coo_cols].add(
+            contrib, indices_are_sorted=True
         )
 
     def to_dense(self) -> Array:
-        if self.is_dense:
+        if self.dense is not None:
             return self.dense
-        n = self.idx.shape[0]
-        out = jnp.zeros((n, self.dim), dtype=self.val.dtype)
-        rows = jnp.broadcast_to(jnp.arange(n)[:, None], self.idx.shape)
-        return out.at[rows.reshape(-1), self.idx.reshape(-1)].add(self.val.reshape(-1))
+        if self.idx is not None:
+            n = self.idx.shape[0]
+            out = jnp.zeros((n, self.dim), dtype=self.val.dtype)
+            rows = jnp.broadcast_to(jnp.arange(n)[:, None], self.idx.shape)
+            return out.at[rows.reshape(-1), self.idx.reshape(-1)].add(
+                self.val.reshape(-1)
+            )
+        out = jnp.zeros((self.coo_n_rows, self.dim), dtype=self.coo_vals.dtype)
+        return out.at[self.coo_rows, self.coo_cols].add(self.coo_vals)
 
     def slice_rows(self, start: int, size: int) -> "FeatureMatrix":
-        if self.is_dense:
+        if self.dense is not None:
             return FeatureMatrix(dim=self.dim, dense=jax.lax.dynamic_slice_in_dim(self.dense, start, size))
+        if self.idx is None:
+            raise NotImplementedError("slice_rows is not supported for COO layout")
         return FeatureMatrix(
             dim=self.dim,
             idx=jax.lax.dynamic_slice_in_dim(self.idx, start, size),
@@ -145,6 +208,39 @@ def batch_from_dense(
     )
 
 
+def sorted_coo_matrix(
+    rows: np.ndarray,
+    cols: np.ndarray,
+    vals: np.ndarray,
+    n_rows: int,
+    dim: int,
+    dtype=jnp.float32,
+    pad_to_multiple: int = 1,
+) -> FeatureMatrix:
+    """Host-side build of the column-sorted COO layout (huge-d path).
+
+    Sorts triplets by column; padding entries (val=0) carry col=dim-1 so the
+    ``indices_are_sorted`` contract of rmatvec holds.
+    """
+    order = np.argsort(cols, kind="stable")
+    m = len(order)
+    m_pad = ((m + pad_to_multiple - 1) // pad_to_multiple) * pad_to_multiple
+    m_pad = max(m_pad, 1)
+    sc = np.full(m_pad, dim - 1, dtype=np.int32)
+    sr = np.zeros(m_pad, dtype=np.int32)
+    sv = np.zeros(m_pad, dtype=np.float64)
+    sc[:m] = cols[order]
+    sr[:m] = rows[order]
+    sv[:m] = vals[order]
+    return FeatureMatrix(
+        dim=dim,
+        coo_cols=jnp.asarray(sc),
+        coo_rows=jnp.asarray(sr),
+        coo_vals=jnp.asarray(sv, dtype),
+        coo_n_rows=n_rows,
+    )
+
+
 def batch_from_coo(
     rows: np.ndarray,
     cols: np.ndarray,
@@ -155,24 +251,32 @@ def batch_from_coo(
     weights: Optional[np.ndarray] = None,
     max_nnz: Optional[int] = None,
     dtype=jnp.float32,
+    layout: str = "ell",
 ) -> LabeledBatch:
-    """Build an ELL-layout batch from COO triplets (host-side, numpy)."""
+    """Build a sparse batch from COO triplets (host-side, numpy).
+
+    layout='ell' gives the row-major padded layout (moderate d);
+    layout='coo' gives column-sorted COO (huge d; see module docstring).
+    """
     n = len(y)
-    counts = np.bincount(rows, minlength=n)
-    k = int(max_nnz if max_nnz is not None else (counts.max() if n else 0))
-    k = max(k, 1)
-    idx = np.zeros((n, k), dtype=np.int32)
-    val = np.zeros((n, k), dtype=np.float64)
-    order = np.argsort(rows, kind="stable")
-    pos = np.zeros(n, dtype=np.int64)
-    for r, c, v in zip(rows[order], cols[order], vals[order]):
-        p = pos[r]
-        if p < k:
-            idx[r, p] = c
-            val[r, p] = v
-            pos[r] = p + 1
+    if layout == "coo":
+        feats = sorted_coo_matrix(rows, cols, vals, n_rows=n, dim=dim, dtype=dtype)
+    else:
+        counts = np.bincount(rows, minlength=n)
+        k = int(max_nnz if max_nnz is not None else (counts.max() if n else 0))
+        k = max(k, 1)
+        idx = np.zeros((n, k), dtype=np.int32)
+        val = np.zeros((n, k), dtype=np.float64)
+        order = np.lexsort((cols, rows))
+        r_s, c_s, v_s = rows[order], cols[order], vals[order]
+        starts = np.cumsum(np.concatenate([[0], np.bincount(r_s, minlength=n)[:-1]]))
+        within = np.arange(len(r_s)) - starts[r_s]
+        keep = within < k
+        idx[r_s[keep], within[keep]] = c_s[keep]
+        val[r_s[keep], within[keep]] = v_s[keep]
+        feats = FeatureMatrix(dim=dim, idx=jnp.asarray(idx), val=jnp.asarray(val, dtype))
     return LabeledBatch(
-        features=FeatureMatrix(dim=dim, idx=jnp.asarray(idx), val=jnp.asarray(val, dtype)),
+        features=feats,
         labels=jnp.asarray(y, dtype),
         offsets=jnp.zeros(n, dtype) if offsets is None else jnp.asarray(offsets, dtype),
         weights=jnp.ones(n, dtype) if weights is None else jnp.asarray(weights, dtype),
@@ -190,17 +294,20 @@ def pad_batch(batch: LabeledBatch, target_rows: int) -> LabeledBatch:
     extra = target_rows - n
     pad1 = lambda a: jnp.concatenate([a, jnp.zeros((extra,), a.dtype)])
     f = batch.features
-    if f.is_dense:
+    if f.dense is not None:
         feats = FeatureMatrix(
             dim=f.dim,
             dense=jnp.concatenate([f.dense, jnp.zeros((extra, f.dim), f.dense.dtype)]),
         )
-    else:
+    elif f.idx is not None:
         feats = FeatureMatrix(
             dim=f.dim,
             idx=jnp.concatenate([f.idx, jnp.zeros((extra, f.idx.shape[1]), f.idx.dtype)]),
             val=jnp.concatenate([f.val, jnp.zeros((extra, f.val.shape[1]), f.val.dtype)]),
         )
+    else:
+        # COO: padded rows have no nnz; only the static row count grows
+        feats = dataclasses.replace(f, coo_n_rows=target_rows)
     return LabeledBatch(
         features=feats,
         labels=pad1(batch.labels),

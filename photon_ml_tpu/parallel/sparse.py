@@ -1,0 +1,225 @@
+"""(data x model)-tiled sparse feature matrix: the huge-d fixed-effect path.
+
+This is the TPU answer to the reference's claim of scaling to "hundreds of
+billions of coefficients" (/root/reference/README.md:56) for the *fixed
+effect*: the coefficient vector is sharded over a "model" mesh axis and the
+sample rows over a "data" axis, so the batch gradient
+
+    g = X^T c     (ValueAndGradientAggregator.scala:137-161's hot axpy loop)
+
+becomes, per device tile, a local sorted scatter over that device's column
+range followed by a psum over the data axis — the exact analogue of the
+reference's treeAggregate all-reduce (SURVEY.md P1), with the model axis
+adding what Spark never had: a partitioned coefficient vector.
+
+Why tiling (and not GSPMD auto-sharding): unstructured gather/scatter on TPU
+executes serially at ~7 cycles/element (measured on v5e; there is no HBM
+cache and pre-SparseCore hardware has no vectorized large-table gather), so
+the single-chip sparse kernel is serialization-bound. Partitioning the nnz by
+(row-range, column-range) divides that serial cost by the device count on
+both the gather (c by row) and scatter (g by column) sides — sparse
+throughput scales linearly with chips, which is the property that matters at
+pod scale. Collectives ride ICI: z partials psum over the model axis,
+gradient partials psum over the data axis.
+
+Layout contract per tile (host-built, static): triplets sorted by local
+column (so the rmatvec scatter runs XLA's sorted fast path and the column
+axis partitions contiguously); padding entries carry lcol = d_local - 1,
+lval = 0, lrow = 0.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import shard_map
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from ..ops.features import LabeledBatch
+from .mesh import DATA_AXIS, MODEL_AXIS
+
+Array = jax.Array
+
+
+@jax.tree_util.register_dataclass
+@dataclasses.dataclass(frozen=True)
+class TiledSparseMatrix:
+    """FeatureMatrix-compatible sparse matrix tiled over a (data, model) mesh.
+
+    Arrays are [n_data, n_model, m_tile], sharded P(data, model, None): each
+    device holds exactly its tile. ``dim`` / ``n_rows`` are the padded global
+    sizes (multiples of the mesh axes).
+    """
+
+    dim: int = dataclasses.field(metadata=dict(static=True))
+    n_rows: int = dataclasses.field(metadata=dict(static=True))
+    mesh: Mesh = dataclasses.field(metadata=dict(static=True))
+    lcol: Optional[Array] = None  # i32[D, M, m_tile], sorted per tile
+    lrow: Optional[Array] = None  # i32[D, M, m_tile]
+    lval: Optional[Array] = None  # f[D, M, m_tile]
+
+    @property
+    def layout(self) -> str:
+        return "tiled"
+
+    @property
+    def is_dense(self) -> bool:
+        return False
+
+    @property
+    def n_local_rows(self) -> int:
+        return self.n_rows // self.mesh.shape[DATA_AXIS]
+
+    @property
+    def d_local(self) -> int:
+        return self.dim // self.mesh.shape[MODEL_AXIS]
+
+    def matvec(self, w: Array) -> Array:
+        """x @ w -> [n] (sharded over data). w: [dim], sharded over model."""
+        n_loc = self.n_local_rows
+
+        def f(lcol, lrow, lval, w_loc):
+            lc, lr, lv = lcol[0, 0], lrow[0, 0], lval[0, 0]
+            wv = jnp.take(w_loc, lc) * lv
+            z = jnp.zeros(n_loc, wv.dtype).at[lr].add(wv)
+            return jax.lax.psum(z, MODEL_AXIS)
+
+        return shard_map(
+            f,
+            mesh=self.mesh,
+            in_specs=(
+                P(DATA_AXIS, MODEL_AXIS, None),
+                P(DATA_AXIS, MODEL_AXIS, None),
+                P(DATA_AXIS, MODEL_AXIS, None),
+                P(MODEL_AXIS),
+            ),
+            out_specs=P(DATA_AXIS),
+        )(self.lcol, self.lrow, self.lval, w)
+
+    def _rmat(self, c: Array, square: bool) -> Array:
+        d_loc = self.d_local
+
+        def f(lcol, lrow, lval, c_loc):
+            lc, lr, lv = lcol[0, 0], lrow[0, 0], lval[0, 0]
+            if square:
+                lv = lv * lv
+            contrib = jnp.take(c_loc, lr) * lv
+            g = jnp.zeros(d_loc, contrib.dtype).at[lc].add(
+                contrib, indices_are_sorted=True
+            )
+            return jax.lax.psum(g, DATA_AXIS)
+
+        return shard_map(
+            f,
+            mesh=self.mesh,
+            in_specs=(
+                P(DATA_AXIS, MODEL_AXIS, None),
+                P(DATA_AXIS, MODEL_AXIS, None),
+                P(DATA_AXIS, MODEL_AXIS, None),
+                P(DATA_AXIS),
+            ),
+            out_specs=P(MODEL_AXIS),
+        )(self.lcol, self.lrow, self.lval, c)
+
+    def rmatvec(self, c: Array) -> Array:
+        """x^T @ c -> [dim] (sharded over model). c: [n], sharded over data."""
+        return self._rmat(c, square=False)
+
+    def sq_rmatvec(self, c: Array) -> Array:
+        return self._rmat(c, square=True)
+
+    def to_dense(self) -> Array:
+        raise NotImplementedError(
+            "TiledSparseMatrix is for huge d; densification is not supported "
+            "(use variance_type SIMPLE, not FULL)"
+        )
+
+
+def tile_sparse_matrix(
+    rows: np.ndarray,
+    cols: np.ndarray,
+    vals: np.ndarray,
+    n_rows: int,
+    dim: int,
+    mesh: Mesh,
+    dtype=jnp.float32,
+) -> TiledSparseMatrix:
+    """Host-side one-time tiling (the analogue of the reference's dataset
+    partitioning shuffle, SURVEY.md P13). Pads n and d to mesh multiples and
+    each tile's nnz to the max tile size.
+    """
+    D = mesh.shape[DATA_AXIS]
+    M = mesh.shape[MODEL_AXIS]
+    n_pad = max(((n_rows + D - 1) // D) * D, D)
+    d_pad = max(((dim + M - 1) // M) * M, M)
+    n_loc, d_loc = n_pad // D, d_pad // M
+
+    tile_r = rows // n_loc
+    tile_c = cols // d_loc
+    key = tile_r * M + tile_c
+    order = np.lexsort((cols, key))
+    r_s, c_s, v_s, k_s = rows[order], cols[order], vals[order], key[order]
+    counts = np.bincount(k_s, minlength=D * M)
+    m_tile = max(int(counts.max()) if len(counts) else 0, 1)
+
+    lcol = np.full((D * M, m_tile), d_loc - 1, dtype=np.int32)
+    lrow = np.zeros((D * M, m_tile), dtype=np.int32)
+    lval = np.zeros((D * M, m_tile), dtype=np.float64)
+    if len(k_s):
+        starts = np.cumsum(np.concatenate([[0], counts[:-1]]))
+        within = np.arange(len(k_s)) - starts[k_s]
+        lcol[k_s, within] = c_s % d_loc
+        lrow[k_s, within] = r_s % n_loc
+        lval[k_s, within] = v_s
+
+    spec = NamedSharding(mesh, P(DATA_AXIS, MODEL_AXIS, None))
+    return TiledSparseMatrix(
+        dim=d_pad,
+        n_rows=n_pad,
+        mesh=mesh,
+        lcol=jax.device_put(lcol.reshape(D, M, m_tile), spec),
+        lrow=jax.device_put(lrow.reshape(D, M, m_tile), spec),
+        lval=jax.device_put(lval.reshape(D, M, m_tile).astype(np.dtype(dtype)), spec),
+    )
+
+
+def tiled_sparse_batch(
+    rows: np.ndarray,
+    cols: np.ndarray,
+    vals: np.ndarray,
+    y: np.ndarray,
+    dim: int,
+    mesh: Mesh,
+    offsets: Optional[np.ndarray] = None,
+    weights: Optional[np.ndarray] = None,
+    dtype=jnp.float32,
+) -> LabeledBatch:
+    """Build a LabeledBatch whose features are mesh-tiled; labels/offsets/
+    weights are zero-padded to the mesh row multiple and sharded over the
+    data axis (padded rows carry weight 0)."""
+    n = len(y)
+    feats = tile_sparse_matrix(rows, cols, vals, n, dim, mesh, dtype=dtype)
+    n_pad = feats.n_rows
+
+    def pad1(a, fill=0.0):
+        out = np.full(n_pad, fill, dtype=np.float64)
+        out[:n] = a
+        return jax.device_put(
+            jnp.asarray(out, dtype), NamedSharding(mesh, P(DATA_AXIS))
+        )
+
+    return LabeledBatch(
+        features=feats,
+        labels=pad1(y),
+        offsets=pad1(np.zeros(n) if offsets is None else offsets),
+        weights=pad1(np.ones(n) if weights is None else weights, fill=0.0),
+    )
+
+
+def replicated_coefficients(w: np.ndarray, mesh: Mesh, dtype=jnp.float32) -> Array:
+    """Place a [dim]-padded coefficient vector sharded over the model axis."""
+    return jax.device_put(jnp.asarray(w, dtype), NamedSharding(mesh, P(MODEL_AXIS)))

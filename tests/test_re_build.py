@@ -1,0 +1,180 @@
+"""Vectorized random-effect dataset build: exact equality against a
+straightforward per-entity loop reference, plus a scale smoke test
+(VERDICT.md round-1 item 3: no per-entity Python loops, millions of entities
+in seconds)."""
+
+import time
+
+import numpy as np
+import pytest
+
+from photon_ml_tpu.game import build_random_effect_dataset
+from photon_ml_tpu.game.data import _hash64, _rows_to_ell
+from photon_ml_tpu.testing import generate_mixed_effect_data
+from photon_ml_tpu.testing.generators import mixed_data_to_raw_dataset
+
+
+def _loop_reference_blocks(raw, feature_shard, re_type, active_cap, lower_bound, seed):
+    """The pre-vectorization per-entity loop implementation, kept as the
+    semantic reference."""
+    n = raw.n_rows
+    ids = raw.id_tags[re_type]
+    rows, cols, vals = raw.shard_coo[feature_shard]
+    uniq, inv = np.unique(ids.astype(str), return_inverse=True)
+    counts = np.bincount(inv, minlength=len(uniq))
+    kept_mask = counts >= lower_bound
+    kept_entities = np.nonzero(kept_mask)[0]
+    kept_entities = kept_entities[np.argsort(-counts[kept_entities], kind="stable")]
+    E = len(kept_entities)
+    old_to_block = np.full(len(uniq), -1, dtype=np.int64)
+    old_to_block[kept_entities] = np.arange(E)
+    cap = active_cap if active_cap is not None else int(counts.max() if len(counts) else 1)
+    K = int(min(int(counts[kept_entities].max()) if E else 1, cap)) or 1
+
+    row_ids = np.arange(n, dtype=np.int64)
+    priority = _hash64(row_ids, seed)
+    entity_of_row = old_to_block[inv]
+    order = np.lexsort((priority, entity_of_row))
+    sorted_rows = row_ids[order]
+    sorted_entity = entity_of_row[order]
+    starts = np.searchsorted(sorted_entity, np.arange(E))
+    rank = np.arange(n) - starts[np.clip(sorted_entity, 0, max(E - 1, 0))]
+    is_active = (sorted_entity >= 0) & (rank < K)
+
+    active_rows = np.full((E, K), -1, dtype=np.int64)
+    weight_scale = np.ones(E)
+    for e in range(E):
+        cnt = counts[kept_entities[e]]
+        if cnt > cap:
+            weight_scale[e] = cnt / cap
+    s = np.nonzero(is_active)[0]
+    active_rows[sorted_entity[s], rank[s]] = sorted_rows[s]
+
+    ell_idx, ell_val = _rows_to_ell(rows, cols, vals, n)
+    S = 1
+    per_entity_cols = []
+    for e in range(E):
+        r = active_rows[e]
+        r = r[r >= 0]
+        c = np.unique(ell_idx[r][ell_val[r] != 0])
+        per_entity_cols.append(c)
+        S = max(S, len(c))
+    proj_cols = np.full((E, S), -1, dtype=np.int32)
+    for e in range(E):
+        c = per_entity_cols[e]
+        proj_cols[e, : len(c)] = c
+
+    feats = np.zeros((E, K, S))
+    labels = np.zeros((E, K))
+    offsets = np.zeros((E, K))
+    weights = np.zeros((E, K))
+    for e in range(E):
+        ks = np.nonzero(active_rows[e] >= 0)[0]
+        r = active_rows[e, ks]
+        labels[e, ks] = raw.labels[r]
+        offsets[e, ks] = raw.offsets[r]
+        weights[e, ks] = raw.weights[r] * weight_scale[e]
+        cols_e = per_entity_cols[e]
+        if len(cols_e) == 0:
+            continue
+        fi = ell_idx[r]
+        fv = ell_val[r]
+        pos = np.clip(np.searchsorted(cols_e, fi), 0, len(cols_e) - 1)
+        hit = (cols_e[pos] == fi) & (fv != 0.0)
+        kk, ff = np.nonzero(hit)
+        feats[e, ks[kk], pos[kk, ff]] = fv[kk, ff]
+    return feats, labels, offsets, weights, proj_cols, active_rows
+
+
+@pytest.mark.parametrize("active_cap,lower_bound", [(None, 1), (8, 1), (8, 3)])
+def test_vectorized_build_equals_loop_reference(active_cap, lower_bound):
+    raw = mixed_data_to_raw_dataset(
+        generate_mixed_effect_data(
+            n=600, d_fixed=4, re_specs={"userId": (40, 6)}, seed=7, entity_skew=1.4
+        )
+    )
+    ds = build_random_effect_dataset(
+        raw, "re", "userShard", "userId",
+        active_cap=active_cap, active_lower_bound=lower_bound, seed=3,
+    )
+    feats, labels, offsets, weights, proj_cols, active_rows = _loop_reference_blocks(
+        raw, "userShard", "userId", active_cap, lower_bound, seed=3
+    )
+    b = ds.blocks
+    np.testing.assert_array_equal(np.asarray(b.proj_cols), proj_cols)
+    np.testing.assert_array_equal(np.asarray(b.active_rows), active_rows.astype(np.int32))
+    np.testing.assert_allclose(np.asarray(b.features), feats, rtol=1e-6)
+    np.testing.assert_allclose(np.asarray(b.labels), labels, rtol=1e-6)
+    np.testing.assert_allclose(np.asarray(b.offsets), offsets, rtol=1e-6)
+    np.testing.assert_allclose(np.asarray(b.weights), weights, rtol=1e-6)
+
+
+def test_build_scales_to_many_entities():
+    """1M entities / 5M rows must build in seconds (host pass is O(nnz log);
+    the round-1 loop implementation was O(entities) Python iterations)."""
+    n, E = 5_000_000, 1_000_000
+    rng = np.random.default_rng(0)
+    ids = rng.integers(0, E, size=n)
+    d_re = 4
+    rows = np.repeat(np.arange(n), d_re)
+    cols = np.tile(np.arange(d_re), n)
+    vals = rng.normal(size=n * d_re)
+    from photon_ml_tpu.io.data import RawDataset
+
+    raw = RawDataset(
+        n_rows=n,
+        labels=(rng.uniform(size=n) < 0.5).astype(np.float64),
+        offsets=np.zeros(n),
+        weights=np.ones(n),
+        shard_coo={"s": (rows, cols, vals)},
+        shard_dims={"s": d_re},
+        id_tags={"userId": ids.astype(str)},
+    )
+    t0 = time.perf_counter()
+    ds = build_random_effect_dataset(raw, "re", "s", "userId", active_cap=16)
+    dt = time.perf_counter() - t0
+    assert ds.blocks.features.shape[0] >= E * 0.99
+    assert dt < 120.0, f"RE build took {dt:.1f}s"
+
+
+def test_size_bucketed_solve_equals_single_block():
+    """Bucketed per-size solves must reproduce the single-block solve exactly
+    (padding rows/cols are mathematically inert)."""
+    import dataclasses as dc
+
+    import jax.numpy as jnp
+
+    from photon_ml_tpu.game import (
+        GLMOptimizationConfig,
+        RandomEffectCoordinate,
+    )
+    from photon_ml_tpu.game.coordinate import _size_buckets
+    from photon_ml_tpu.ops.regularization import RegularizationContext
+    from photon_ml_tpu.optimize import OptimizerConfig
+
+    raw = mixed_data_to_raw_dataset(
+        generate_mixed_effect_data(
+            n=1500, d_fixed=4, re_specs={"userId": (60, 8)}, seed=11, entity_skew=1.6
+        )
+    )
+    ds = build_random_effect_dataset(raw, "re", "userShard", "userId", active_cap=64)
+    assert _size_buckets(ds) is not None and len(_size_buckets(ds)) > 1
+    cfg = GLMOptimizationConfig(
+        optimizer=OptimizerConfig(tolerance=1e-10, max_iterations=60),
+        regularization=RegularizationContext("L2"),
+        reg_weight=0.5,
+    )
+    coord = RandomEffectCoordinate(dataset=ds, task="logistic_regression", config=cfg)
+    m_bucketed, r_bucketed = coord.train(None)
+
+    ds_flat = dc.replace(ds, entity_counts=None, entity_subspace_dims=None)
+    coord_flat = RandomEffectCoordinate(
+        dataset=ds_flat, task="logistic_regression", config=cfg
+    )
+    m_flat, r_flat = coord_flat.train(None)
+    np.testing.assert_allclose(
+        np.asarray(m_bucketed.coef_values), np.asarray(m_flat.coef_values), atol=1e-12
+    )
+    np.testing.assert_array_equal(
+        np.asarray(r_bucketed.iterations), np.asarray(r_flat.iterations)
+    )

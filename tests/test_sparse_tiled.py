@@ -1,0 +1,140 @@
+"""Huge-d sparse path: sorted-COO layout and (data x model)-tiled sharding.
+
+VERDICT.md round-1 item 1: an 8-device virtual-mesh test asserting that the
+model-axis-sharded fixed-effect solve is exactly the replicated solve, plus
+kernel-level parity of every layout against dense.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from photon_ml_tpu.ops import GLMObjective, LOGISTIC, batch_from_coo, batch_from_dense
+from photon_ml_tpu.ops.features import sorted_coo_matrix
+from photon_ml_tpu.optimize import OptimizerConfig, optimize
+from photon_ml_tpu.parallel import make_mesh
+from photon_ml_tpu.parallel.sparse import (
+    TiledSparseMatrix,
+    replicated_coefficients,
+    tile_sparse_matrix,
+    tiled_sparse_batch,
+)
+
+
+def _random_coo(rng, n, d, k):
+    rows = np.repeat(np.arange(n), k)
+    cols = rng.integers(0, d, size=n * k)
+    vals = rng.normal(size=n * k)
+    # merge duplicate (row, col) pairs like a real dataset build would
+    keys = rows.astype(np.int64) * d + cols
+    uniq, inv = np.unique(keys, return_inverse=True)
+    merged = np.zeros(len(uniq))
+    np.add.at(merged, inv, vals)
+    return (uniq // d).astype(np.int64), (uniq % d).astype(np.int64), merged
+
+
+def _dense_of(rows, cols, vals, n, d):
+    x = np.zeros((n, d))
+    np.add.at(x, (rows, cols), vals)
+    return x
+
+
+def test_sorted_coo_matches_dense(rng):
+    n, d, k = 64, 300, 5
+    rows, cols, vals = _random_coo(rng, n, d, k)
+    x = _dense_of(rows, cols, vals, n, d)
+    fm = sorted_coo_matrix(rows, cols, vals, n_rows=n, dim=d, dtype=jnp.float64)
+    w = rng.normal(size=d)
+    c = rng.normal(size=n)
+    np.testing.assert_allclose(np.asarray(fm.matvec(jnp.asarray(w))), x @ w, rtol=1e-10)
+    np.testing.assert_allclose(np.asarray(fm.rmatvec(jnp.asarray(c))), x.T @ c, rtol=1e-10)
+    np.testing.assert_allclose(
+        np.asarray(fm.sq_rmatvec(jnp.asarray(c))), (x * x).T @ c, rtol=1e-10
+    )
+    np.testing.assert_allclose(np.asarray(fm.to_dense()), x, rtol=1e-12)
+
+
+@pytest.mark.parametrize("shape", [(1, 1), (8, 1), (1, 8), (4, 2), (2, 4)])
+def test_tiled_matches_dense_all_mesh_shapes(rng, shape):
+    n, d, k = 96, 200, 4
+    rows, cols, vals = _random_coo(rng, n, d, k)
+    x = _dense_of(rows, cols, vals, n, d)
+    mesh = make_mesh(n_data=shape[0], n_model=shape[1])
+    fm = tile_sparse_matrix(rows, cols, vals, n, d, mesh, dtype=jnp.float64)
+    w = np.zeros(fm.dim)
+    w[:d] = rng.normal(size=d)
+    c = np.zeros(fm.n_rows)
+    c[:n] = rng.normal(size=n)
+    z = np.asarray(fm.matvec(replicated_coefficients(w, mesh, jnp.float64)))
+    np.testing.assert_allclose(z[:n], x @ w[:d], rtol=1e-10)
+    assert np.all(z[n:] == 0)
+    g = np.asarray(fm.rmatvec(jnp.asarray(c)))
+    np.testing.assert_allclose(g[:d], x.T @ c[:n], rtol=1e-10)
+    assert np.all(g[d:] == 0)
+    g2 = np.asarray(fm.sq_rmatvec(jnp.asarray(c)))
+    np.testing.assert_allclose(g2[:d], (x * x).T @ c[:n], rtol=1e-10)
+
+
+def test_sharded_solve_equals_replicated_solve(rng):
+    """The headline invariant: L-BFGS on the (data=2 x model=4)-tiled sparse
+    objective lands on the same coefficients as the plain single-device dense
+    solve."""
+    n, d, k = 400, 257, 6  # d deliberately not a multiple of the model axis
+    rows, cols, vals = _random_coo(rng, n, d, k)
+    x = _dense_of(rows, cols, vals, n, d)
+    logits = x @ (rng.normal(size=d) * 0.5)
+    y = (rng.uniform(size=n) < 1.0 / (1.0 + np.exp(-logits))).astype(np.float64)
+
+    cfg = OptimizerConfig(tolerance=1e-10, max_iterations=200)
+    lam = 0.5
+
+    # replicated dense reference
+    dense_batch = batch_from_dense(x, y, dtype=jnp.float64)
+    obj = GLMObjective(loss=LOGISTIC, batch=dense_batch, l2=lam)
+    res_ref = optimize(obj.value_and_grad, jnp.zeros(d, jnp.float64), cfg)
+
+    # tiled sharded solve
+    mesh = make_mesh(n_data=2, n_model=4)
+    tb = tiled_sparse_batch(rows, cols, vals, y, d, mesh, dtype=jnp.float64)
+    obj_t = GLMObjective(loss=LOGISTIC, batch=tb, l2=lam)
+    w0 = replicated_coefficients(np.zeros(tb.features.dim), mesh, jnp.float64)
+    res_t = optimize(obj_t.value_and_grad, w0, cfg)
+
+    w_sharded = np.asarray(res_t.coefficients)
+    np.testing.assert_allclose(w_sharded[:d], np.asarray(res_ref.coefficients), atol=1e-8)
+    assert np.all(w_sharded[d:] == 0)
+
+    # and the COO single-device layout agrees too
+    coo_batch = batch_from_coo(rows, cols, vals, y, d, dtype=jnp.float64, layout="coo")
+    obj_c = GLMObjective(loss=LOGISTIC, batch=coo_batch, l2=lam)
+    res_c = optimize(obj_c.value_and_grad, jnp.zeros(d, jnp.float64), cfg)
+    np.testing.assert_allclose(
+        np.asarray(res_c.coefficients), np.asarray(res_ref.coefficients), atol=1e-8
+    )
+
+
+def test_tiled_objective_value_grad_parity(rng):
+    n, d, k = 128, 97, 3
+    rows, cols, vals = _random_coo(rng, n, d, k)
+    x = _dense_of(rows, cols, vals, n, d)
+    y = (rng.uniform(size=n) < 0.5).astype(np.float64)
+    mesh = make_mesh(n_data=4, n_model=2)
+    tb = tiled_sparse_batch(rows, cols, vals, y, d, mesh, dtype=jnp.float64)
+    obj_t = GLMObjective(loss=LOGISTIC, batch=tb, l2=0.25)
+    obj_d = GLMObjective(
+        loss=LOGISTIC, batch=batch_from_dense(x, y, dtype=jnp.float64), l2=0.25
+    )
+    w = rng.normal(size=d)
+    w_pad = np.zeros(tb.features.dim)
+    w_pad[:d] = w
+    v_t, g_t = obj_t.value_and_grad(replicated_coefficients(w_pad, mesh, jnp.float64))
+    v_d, g_d = obj_d.value_and_grad(jnp.asarray(w))
+    np.testing.assert_allclose(float(v_t), float(v_d), rtol=1e-12)
+    np.testing.assert_allclose(np.asarray(g_t)[:d], np.asarray(g_d), rtol=1e-9)
+    # Hessian diagonal (SIMPLE variance path) also agrees
+    np.testing.assert_allclose(
+        np.asarray(obj_t.hessian_diagonal(replicated_coefficients(w_pad, mesh, jnp.float64)))[:d],
+        np.asarray(obj_d.hessian_diagonal(jnp.asarray(w))),
+        rtol=1e-9,
+    )
