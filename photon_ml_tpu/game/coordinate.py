@@ -177,7 +177,7 @@ class RandomEffectCoordinate(Coordinate):
             max_cg_iterations=solver_cfg.max_cg_iterations,
             max_improvement_failures=solver_cfg.max_improvement_failures,
         )
-        segments = _size_buckets(self.dataset)
+        segments = _size_buckets(self.dataset, align=_entity_shard_align(blocks))
         if segments is None:
             results = _train_blocks(
                 blocks.features, blocks.labels, offsets, blocks.weights,
@@ -231,13 +231,27 @@ class RandomEffectCoordinate(Coordinate):
         return model.score_ell_rows(row_entity, self.dataset.ell_idx, self.dataset.ell_val)
 
 
-def _size_buckets(dataset: RandomEffectDataset, min_dim: int = 8):
+def _pow2_ceil(x: np.ndarray) -> np.ndarray:
+    """Exact elementwise 2**ceil(log2(max(x, 1))) for int64 inputs < 2^53
+    (frexp exponents of exactly-represented ints are bit_lengths)."""
+    v = np.maximum(np.asarray(x, dtype=np.int64), 1) - 1
+    return np.int64(1) << np.frexp(v.astype(np.float64))[1].astype(np.int64)
+
+
+def _size_buckets(dataset: RandomEffectDataset, min_dim: int = 8, align: int = 1):
     """Contiguous entity segments with power-of-2-rounded (K, S) block shapes.
 
     Returns [(start, end, K_b, S_b)], or None when per-entity stats are
     unavailable or bucketing cannot shrink anything. Rounding to powers of two
     (floored at ``min_dim``) bounds the number of distinct compiled solver
     shapes at O(log^2) while removing the bulk of the padding FLOPs.
+
+    Fully vectorized (no per-entity Python work — this runs on every train()
+    call, potentially over millions of entities). ``align`` snaps segment
+    boundaries up to multiples of the per-device entity-chunk size so bucket
+    slices of mesh-sharded blocks never split a device shard (counts are
+    non-increasing, so the merged head of the next run still fits the larger
+    preceding block shape).
     """
     counts = dataset.entity_counts
     svec = dataset.entity_subspace_dims
@@ -245,21 +259,46 @@ def _size_buckets(dataset: RandomEffectDataset, min_dim: int = 8):
         return None
     E, K, S = dataset.blocks.features.shape
 
-    def pow2_ceil(x):
-        return 1 << int(max(x, 1) - 1).bit_length()
+    kb_of = np.minimum(
+        np.maximum(_pow2_ceil(np.asarray(counts[:E], dtype=np.int64)), min_dim), K
+    )
+    bounds = np.flatnonzero(np.diff(kb_of)) + 1  # starts of new equal-K runs
+    if align > 1:
+        bounds = np.unique(-(-bounds // align) * align)
+    bounds = bounds[(bounds > 0) & (bounds < E)]
+    starts = np.concatenate([[0], bounds])
+    ends = np.concatenate([bounds, [E]])
 
-    kb_of = np.minimum([max(pow2_ceil(c), min_dim) for c in counts], K)
-    # counts are non-increasing, so equal-K runs are contiguous
-    segments = []
-    start = 0
-    for i in range(1, E + 1):
-        if i == E or kb_of[i] != kb_of[start]:
-            sb = min(max(pow2_ceil(int(svec[start:i].max())), min_dim), S)
-            segments.append((start, i, int(kb_of[start]), int(sb)))
-            start = i
+    sv = np.asarray(svec[:E], dtype=np.int64)
+    sb_of = np.minimum(
+        np.maximum(_pow2_ceil(np.maximum.reduceat(sv, starts)), min_dim), S
+    )
+    segments = [
+        (
+            int(s),
+            int(e),
+            int(kb_of[s]),  # counts non-increasing => max K of the segment
+            int(sb),
+        )
+        for s, e, sb in zip(starts, ends, sb_of)
+    ]
     if len(segments) == 1 and segments[0][2] >= K and segments[0][3] >= S:
         return None
     return segments
+
+
+def _entity_shard_align(blocks) -> int:
+    """Per-device chunk size of mesh-sharded entity blocks (1 = unsharded):
+    the boundary multiple that keeps bucket slices shard-aligned."""
+    try:
+        sh = blocks.features.sharding
+        if len(sh.device_set) > 1:
+            chunk = sh.shard_shape(blocks.features.shape)[0]
+            if chunk < blocks.features.shape[0]:
+                return int(chunk)
+    except Exception:
+        pass
+    return 1
 
 
 def _concat_results(parts, S: int) -> SolverResult:
@@ -297,7 +336,10 @@ def _project_model_values(
         and list(map(str, model.entity_ids)) == list(map(str, dataset.entity_ids))
     ):
         return jnp.asarray(values, dtype)  # same layout: reuse directly
-    # general path: dense per-entity gather on host
+    # general path: one vectorized sorted-key lookup over all (entity, column)
+    # support pairs — no per-entity Python loop and no dense [E, global_dim]
+    # intermediate, so re-projecting a large RE model from a differently
+    # laid-out checkpoint stays O(nnz log nnz) host time.
     dim = int(
         max(
             int(np.asarray(blocks.proj_cols).max(initial=0)),
@@ -307,19 +349,23 @@ def _project_model_values(
     )
     vals = np.asarray(values)
     idx = np.asarray(model.coef_indices)
-    dense = np.zeros((model.num_entities, dim))
-    for e in range(model.num_entities):
-        m = idx[e] >= 0
-        dense[e, idx[e][m]] = vals[e][m]
-    rows = model.rows_for(dataset.entity_ids)
-    w0 = np.zeros((E, S))
+    me, ms = np.nonzero(idx >= 0)
+    mkeys = me.astype(np.int64) * dim + idx[me, ms]
+    order = np.argsort(mkeys, kind="stable")
+    mkeys_s = mkeys[order]
+    mvals_s = vals[me, ms][order]
+
+    rows = np.asarray(model.rows_for(dataset.entity_ids))  # [E] model row or -1
     pc = np.asarray(blocks.proj_cols)
-    for e in range(E):
-        r = rows[e]
-        if r < 0:
-            continue
-        m = pc[e] >= 0
-        w0[e, m] = dense[r, pc[e][m]]
+    de, dsl = np.nonzero((pc >= 0) & (rows[:, None] >= 0))
+    dkeys = rows[de].astype(np.int64) * dim + pc[de, dsl]
+    w0 = np.zeros((E, S))
+    if len(mkeys_s) and len(dkeys):
+        # side='right' - 1: among duplicate support columns the LAST stored
+        # value wins, matching numpy fancy-assignment (the prior dense path)
+        pos = np.clip(np.searchsorted(mkeys_s, dkeys, side="right") - 1, 0, None)
+        hit = mkeys_s[pos] == dkeys
+        w0[de[hit], dsl[hit]] = mvals_s[pos[hit]]
     return jnp.asarray(w0, dtype)
 
 

@@ -161,10 +161,16 @@ class CoordinateDescent:
         return "linear_regression"
 
     def _evaluate(self, models: Mapping[str, object]) -> EvaluationResults:
+        """Accumulate per-coordinate validation scores on device; a single
+        host transfer feeds the (host-side) metric evaluators."""
         v = self.validation
-        total = np.asarray(v.offsets, dtype=np.float64).copy()
+        acc = None
         for name, model in models.items():
             fn = v.score_fns.get(name)
             if fn is not None:
-                total = total + np.asarray(fn(model), dtype=np.float64)
+                s = fn(model)
+                acc = s if acc is None else acc + s
+        total = np.asarray(v.offsets, dtype=np.float64)
+        if acc is not None:
+            total = total + np.asarray(acc, dtype=np.float64)
         return v.suite.evaluate(total)

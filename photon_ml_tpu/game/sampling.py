@@ -7,13 +7,16 @@ DownSamplerHelper.scala:26-40.
 
 Down-sampling only affects the *training* batch; scoring always sees all rows.
 Realized as a weight transform (dropped rows get weight 0) so batch shapes stay
-static for jit; determinism comes from a seeded ``numpy`` generator, mirroring
-the reference's per-partition deterministic seeds (recomputability, SURVEY §5).
+static for jit; determinism comes from a counter-based ``jax.random`` key,
+mirroring the reference's per-partition deterministic seeds (recomputability,
+SURVEY §5). Runs entirely on device — no host round-trip per train call.
 """
 
 from __future__ import annotations
 
-import numpy as np
+import dataclasses
+
+import jax
 import jax.numpy as jnp
 
 from ..ops.features import LabeledBatch
@@ -34,16 +37,14 @@ def down_sample(
         return batch
     if not (0.0 < rate < 1.0):
         raise ValueError(f"down-sampling rate must be in (0, 1): {rate}")
-    rng = np.random.default_rng(seed)
-    n = batch.n_rows
-    keep = rng.uniform(size=n) < rate
-    labels = np.asarray(batch.labels)
-    weights = np.asarray(batch.weights)
+    keep = (
+        jax.random.uniform(jax.random.PRNGKey(seed), (batch.n_rows,)) < rate
+    )
+    labels = batch.labels
+    weights = batch.weights
     if is_binary_task(task):
         pos = labels > POSITIVE_RESPONSE_THRESHOLD
-        new_w = np.where(pos, weights, np.where(keep, weights / rate, 0.0))
+        new_w = jnp.where(pos, weights, jnp.where(keep, weights / rate, 0.0))
     else:
-        new_w = np.where(keep, weights, 0.0)
-    import dataclasses
-
-    return dataclasses.replace(batch, weights=jnp.asarray(new_w, batch.weights.dtype))
+        new_w = jnp.where(keep, weights, 0.0)
+    return dataclasses.replace(batch, weights=new_w.astype(batch.weights.dtype))

@@ -154,7 +154,23 @@ class FeatureMatrix:
         if self.dense is not None:
             return FeatureMatrix(dim=self.dim, dense=jax.lax.dynamic_slice_in_dim(self.dense, start, size))
         if self.idx is None:
-            raise NotImplementedError("slice_rows is not supported for COO layout")
+            # COO row window with static shapes: the nnz arrays keep their
+            # length (so this jits with a traced ``start``); entries outside
+            # [start, start+size) are zeroed and rows rebased. Columns are
+            # untouched, so the sorted-scatter contract of rmatvec holds.
+            # Start is clamped to match dynamic_slice semantics of the other
+            # layouts.
+            start = jnp.clip(start, 0, max(self.coo_n_rows - size, 0))
+            in_range = (self.coo_rows >= start) & (self.coo_rows < start + size)
+            return FeatureMatrix(
+                dim=self.dim,
+                coo_cols=self.coo_cols,
+                coo_rows=jnp.where(in_range, self.coo_rows - start, 0).astype(
+                    self.coo_rows.dtype
+                ),
+                coo_vals=jnp.where(in_range, self.coo_vals, 0),
+                coo_n_rows=size,
+            )
         return FeatureMatrix(
             dim=self.dim,
             idx=jax.lax.dynamic_slice_in_dim(self.idx, start, size),
