@@ -3,19 +3,21 @@
 Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
 
 Default workload (BASELINE.md config 3 shape): synthetic GLMix — fixed-effect
-logistic regression (data-parallel, TRON, d=1024 so the margins/Hessian
-matmuls engage the MXU) + per-user random effect (entity-blocked batched
-L-BFGS) — one full coordinate-descent sweep. Reference publishes no numbers
-(BASELINE.md), so vs_baseline is measured against an independent single-node
-CPU implementation (numpy/scipy L-BFGS + per-entity scipy solves, the
-Spark-executor stand-in), on the same data and solver settings, with the
-per-entity loop time extrapolated from a subsample.
+logistic regression (data-parallel, TRON, n=500k x d=1024 so the
+margins/Hessian matmuls engage and hold the MXU) + per-user random effect
+(entity-blocked batched L-BFGS) — one full coordinate-descent sweep.
+Reference publishes no numbers (BASELINE.md), so vs_baseline is measured
+against an independent single-node CPU implementation (numpy/scipy L-BFGS +
+per-entity scipy solves, the Spark-executor stand-in), on the same data and
+solver settings, with the per-entity loop time extrapolated from a subsample.
 
 value = examples/sec/chip for one CD sweep = n_rows / sweep_wall_clock.
 
-Extra configs (numbers recorded in BASELINE.md):
+Extra configs — measured values for ALL configs are recorded in BASELINE.md
+("Measured" section) with the exact commands:
   python bench.py --config sparse    # d=10M sorted-COO fixed effect vs scipy
   python bench.py --config billion   # 1B-coefficient streaming RE sweep
+  python bench.py --config tiled     # per-tile cost division under 8-way tiling
 """
 
 from __future__ import annotations
@@ -26,40 +28,74 @@ import time
 import numpy as np
 
 
-def build_data(n=200_000, d_fixed=128, n_users=5_000, d_re=16, seed=0):
-    from photon_ml_tpu.testing import generate_mixed_effect_data
-    from photon_ml_tpu.testing.generators import mixed_data_to_raw_dataset
+def build_data(n=500_000, d_fixed=1024, n_users=20_000, d_re=32, seed=0):
+    """Bench-scale GLMix data, generated directly in float32 (the library's
+    generate_mixed_effect_data is f64 and COO-materializes the dense global
+    shard — fine for tests, wasteful at bench n).
 
-    data = generate_mixed_effect_data(
-        n=n,
-        d_fixed=d_fixed,
-        re_specs={"userId": (n_users, d_re)},
-        seed=seed,
-        entity_skew=1.1,
+    Returns (gx, y, ex, ids): dense global features, labels, per-user
+    features, user ids."""
+    rng = np.random.default_rng(seed)
+    gx = rng.standard_normal((n, d_fixed), dtype=np.float32)
+    gx[:, -1] = 1.0
+    w = (rng.standard_normal(d_fixed) / np.sqrt(d_fixed)).astype(np.float32)
+    z = gx @ w
+    probs = 1.0 / np.arange(1, n_users + 1) ** 1.1
+    probs /= probs.sum()
+    assign = rng.choice(n_users, size=n, p=probs)
+    ex = rng.standard_normal((n, d_re), dtype=np.float32)
+    ex[:, -1] = 1.0
+    w_u = (rng.standard_normal((n_users, d_re)) / np.sqrt(d_re)).astype(np.float32)
+    z = z + np.einsum("nd,nd->n", ex, w_u[assign])
+    y = (rng.uniform(size=n) < 1.0 / (1.0 + np.exp(-z))).astype(np.float32)
+    ids = np.char.add("u", assign.astype(str)).astype(object)
+    return gx, y, ex, ids
+
+
+def _glmix_datasets(gx, y, ex, ids):
+    """Product-path datasets without the dense-global-COO detour: the fixed
+    effect batches the dense matrix directly; the RE build runs the real
+    pipeline on a userShard-only RawDataset."""
+    from photon_ml_tpu.game.data import FixedEffectDataset, build_random_effect_dataset
+    from photon_ml_tpu.io.data import RawDataset
+    from photon_ml_tpu.ops.features import batch_from_dense
+
+    n, d_re = ex.shape
+    rows = np.repeat(np.arange(n), d_re)
+    cols = np.tile(np.arange(d_re), n)
+    raw = RawDataset(
+        n_rows=n,
+        labels=y.astype(np.float64),
+        offsets=np.zeros(n),
+        weights=np.ones(n),
+        shard_coo={"userShard": (rows, cols, ex.reshape(-1).astype(np.float64))},
+        shard_dims={"userShard": d_re},
+        id_tags={"userId": ids},
     )
-    return data, mixed_data_to_raw_dataset(data)
-
-
-def bench_tpu(raw, reg=1.0, sweeps=1):
-    import jax
-
-    from photon_ml_tpu.game import (
-        CoordinateDescent,
-        FixedEffectCoordinate,
-        GLMOptimizationConfig,
-        RandomEffectCoordinate,
-        build_fixed_effect_dataset,
-        build_random_effect_dataset,
+    fe_ds = FixedEffectDataset(
+        coordinate_id="global",
+        feature_shard="global",
+        batch=batch_from_dense(gx, y),
+        true_dim=gx.shape[1],
+        true_n_rows=n,
     )
-    from photon_ml_tpu.ops.regularization import RegularizationContext
-    from photon_ml_tpu.optimize import OptimizerConfig, OptimizerType
-
-    fe_ds = build_fixed_effect_dataset(raw, "global", "global", layout="dense")
     # active-data cap bounds the K dimension of the entity blocks under skew
     # (the reference's numActiveDataPointsUpperBound; essential for GLMix)
     re_ds = build_random_effect_dataset(
         raw, "per-user", "userShard", "userId", active_cap=256
     )
+    return fe_ds, re_ds
+
+
+def bench_tpu(fe_ds, re_ds, reg=1.0, sweeps=1):
+    from photon_ml_tpu.game import (
+        CoordinateDescent,
+        FixedEffectCoordinate,
+        GLMOptimizationConfig,
+        RandomEffectCoordinate,
+    )
+    from photon_ml_tpu.ops.regularization import RegularizationContext
+    from photon_ml_tpu.optimize import OptimizerConfig, OptimizerType
     cfg_fe = GLMOptimizationConfig(
         optimizer=OptimizerConfig(
             optimizer_type=OptimizerType.TRON, tolerance=1e-6, max_iterations=10
@@ -94,20 +130,18 @@ def bench_tpu(raw, reg=1.0, sweeps=1):
     return wall, result
 
 
-def bench_cpu_baseline(data, raw, reg=1.0, entity_subsample=10):
-    """Independent numpy/scipy implementation of the same sweep."""
+def bench_cpu_baseline(gx, y, ex, ids, reg=1.0, entity_subsample=10):
+    """Independent numpy/scipy implementation of the same sweep (single
+    core — this host has one). f32 matmuls keep the comparison generous to
+    the baseline (f32 BLAS ~2x f64 on CPU)."""
     import scipy.optimize
-
-    n = raw.n_rows
-    gx = data.global_x
-    y = raw.labels
 
     def logistic_vg(x, yv, lam):
         def f(w):
-            z = x @ w
+            z = x @ w.astype(np.float32)
             v = np.sum(np.log1p(np.exp(-np.abs(z))) + np.maximum(z, 0) - yv * z)
-            g = x.T @ (1.0 / (1.0 + np.exp(-z)) - yv)
-            return v + 0.5 * lam * w @ w, g + lam * w
+            g = x.T @ (1.0 / (1.0 + np.exp(-z)) - yv).astype(np.float32)
+            return float(v) + 0.5 * lam * w @ w, g.astype(np.float64) + lam * w
 
         return f
 
@@ -120,12 +154,10 @@ def bench_cpu_baseline(data, raw, reg=1.0, entity_subsample=10):
         method="L-BFGS-B",
         options=dict(maxiter=10),
     )
-    fixed_scores = gx @ r.x
+    fixed_scores = gx @ r.x.astype(np.float32)
     t_fixed = time.perf_counter() - t0
 
     # random effects: per-entity solves on a subsample, extrapolated
-    ex = data.entity_x["userId"]
-    ids = raw.id_tags["userId"]
     uniq, inv = np.unique(ids.astype(str), return_inverse=True)
     order = np.argsort(inv, kind="stable")
     bounds = np.searchsorted(inv[order], np.arange(len(uniq) + 1))
@@ -183,11 +215,13 @@ def bench_sparse_huge_d(n=200_000, d=10_000_000, k=32, lam=1.0, max_iter=20):
     obj = GLMObjective(loss=LOGISTIC, batch=batch, l2=lam)
     cfg = OptimizerConfig(tolerance=1e-9, max_iterations=max_iter)
     optimize(obj.value_and_grad, jnp.zeros(d, jnp.float32), cfg)  # compile
-    t0 = time.perf_counter()
-    res = optimize(obj.value_and_grad, jnp.zeros(d, jnp.float32), cfg)
-    iters = int(res.iterations)
-    float(res.loss)
-    wall_tpu = time.perf_counter() - t0
+    wall_tpu = float("inf")
+    for _ in range(2):  # best-of-2: the remote-device tunnel adds jitter
+        t0 = time.perf_counter()
+        res = optimize(obj.value_and_grad, jnp.zeros(d, jnp.float32), cfg)
+        iters = int(res.iterations)
+        float(res.loss)
+        wall_tpu = min(wall_tpu, time.perf_counter() - t0)
 
     def f(w):
         z = x_csr @ w
@@ -209,14 +243,78 @@ def bench_sparse_huge_d(n=200_000, d=10_000_000, k=32, lam=1.0, max_iter=20):
     }
 
 
+def bench_tiled_division(n=200_000, d=10_000_000, k=32, lam=1.0, n_timing=20):
+    """Scaling evidence for the (data x model) tiling on the hardware we
+    actually have (ONE chip; this host's CPU has one core, so a virtual-mesh
+    wall-clock ratio would only measure time-slicing): the sparse fixed-effect
+    kernel cost is serialization-bound in nnz (ops/features.py), and tiling
+    gives each device 1/(D*M) of the nnz. This measures the fused
+    value+gradient at the FULL nnz and at the exact (2x4)-mesh tile-(0,0)
+    workload — the per-device share — on the same chip.
+
+    value = measured speedup at the 1/8 workload (ideal 8.0: cost divides
+    linearly with the tile share, i.e. 8-way tiling is ~8x per-chip less
+    work); vs_baseline = value / 8 (the linearity efficiency)."""
+    import jax
+    import jax.numpy as jnp
+
+    from photon_ml_tpu.ops import GLMObjective, LOGISTIC, batch_from_coo
+    from photon_ml_tpu.ops.glm import vg_fn
+
+    D, M = 2, 4
+    rng = np.random.default_rng(0)
+    rows = np.repeat(np.arange(n), k).astype(np.int64)
+    cols = rng.integers(0, d, size=n * k).astype(np.int64)
+    vals = (rng.normal(size=n * k) * 0.3).astype(np.float64)
+    y = (rng.uniform(size=n) < 0.5).astype(np.float64)
+
+    def timed_vg(batch, dim):
+        obj = GLMObjective(loss=LOGISTIC, batch=batch, l2=lam)
+        f = jax.jit(vg_fn(obj))
+        w = jnp.zeros(dim, jnp.float32)
+        v, g = f(w)
+        jax.block_until_ready((v, g))  # compile
+        t0 = time.perf_counter()
+        for _ in range(n_timing):
+            v, g = f(w)
+        jax.block_until_ready((v, g))
+        return (time.perf_counter() - t0) / n_timing
+
+    full = batch_from_coo(rows, cols, vals, y, d, dtype=jnp.float32, layout="coo")
+    t_full = timed_vg(full, d)
+
+    # tile (0, 0) of a (data=2 x model=4) mesh: rows [0, n/D), cols [0, d/M)
+    sel = (rows < n // D) & (cols < d // M)
+    tile = batch_from_coo(
+        rows[sel], cols[sel], vals[sel], y[: n // D], d // M,
+        dtype=jnp.float32, layout="coo",
+    )
+    t_tile = timed_vg(tile, d // M)
+
+    speedup = t_full / t_tile
+    return {
+        "metric": "tiled_sparse_per_chip_cost_division",
+        "value": round(speedup, 2),
+        "unit": (
+            f"x speedup of the (2x4)-mesh per-tile value+grad vs full "
+            f"(d=10M COO, nnz {len(rows)/1e6:.1f}M -> {int(sel.sum())/1e6:.2f}M; "
+            "ideal 8.0 = cost divides linearly across 8 devices)"
+        ),
+        "vs_baseline": round(speedup / (D * M), 2),
+    }
+
+
 def bench_billion_coef(n_slices=4, e_slice=32_768, k=16, s=256, total_coef=1_024_000_000):
     """North-star scale (reference README.md:56 "hundreds of billions of
     coefficients"): random-effect coefficients at 1B+ scale, trained as
     streamed entity-block slices through the chip — each slice is one vmapped
     masked L-BFGS solve of e_slice entities. Reports steady-state
-    examples/sec/chip measured over n_slices slices (the full 1B-coefficient
-    sweep is slices = total_coef / (e_slice*s) of identical work; host->device
-    streaming overlaps with compute in a real input pipeline).
+    examples/sec/chip measured over n_slices solves rotating between two
+    DISTINCT pre-staged slices (the full 1B-coefficient sweep is slices =
+    total_coef / (e_slice*s) of identical work). Host->device streaming of
+    slice data is EXCLUDED from the timing (stated in the unit string): in a
+    real input pipeline it overlaps with the multi-second compute of the
+    previous slice.
 
     vs_baseline: scipy solves the identical per-entity problems sequentially
     (single core, the reference's executor-core stand-in), extrapolated from
@@ -242,11 +340,17 @@ def bench_billion_coef(n_slices=4, e_slice=32_768, k=16, s=256, total_coef=1_024
         max_cg_iterations=20, max_improvement_failures=5,
     )
     args = [jnp.asarray(a) for a in (feats, y, off, wt, w0, zeros, ones)]
+    # second distinct slice so the steady-state loop is not re-timing one
+    # device-resident buffer
+    feats2 = (rng.normal(size=(e_slice, k, s)) * 0.3).astype(np.float32)
+    y2 = (rng.uniform(size=(e_slice, k)) < 0.5).astype(np.float32)
+    args2 = [jnp.asarray(feats2), jnp.asarray(y2)] + args[2:]
+    slices = [args, args2]
     r = _train_blocks(*args, **kw)
     float(jnp.sum(r.coefficients))  # compile + force
     t0 = time.perf_counter()
-    for _ in range(n_slices):
-        r = _train_blocks(*args, **kw)
+    for i in range(n_slices):
+        r = _train_blocks(*slices[i % 2], **kw)
         float(jnp.sum(r.coefficients))
     wall = time.perf_counter() - t0
     ex_per_sec = n_slices * e_slice * k / wall
@@ -275,7 +379,8 @@ def bench_billion_coef(n_slices=4, e_slice=32_768, k=16, s=256, total_coef=1_024
         "unit": (
             f"examples/sec/chip (streamed entity blocks, {coef_per_sec/1e6:.0f}M "
             f"coef/s, {total_coef/1e9:.2f}B-coefficient sweep = "
-            f"{total_coef // (e_slice * s)} slices)"
+            f"{total_coef // (e_slice * s)} slices; H2D slice streaming "
+            "excluded — overlaps compute in a real pipeline)"
         ),
         "vs_baseline": round(ex_per_sec / cpu_ex_per_sec, 2),
     }
@@ -285,7 +390,9 @@ def main():
     import argparse
 
     p = argparse.ArgumentParser()
-    p.add_argument("--config", choices=["glmix", "sparse", "billion"], default="glmix")
+    p.add_argument(
+        "--config", choices=["glmix", "sparse", "billion", "tiled"], default="glmix"
+    )
     a = p.parse_args()
 
     if a.config == "sparse":
@@ -294,13 +401,17 @@ def main():
     if a.config == "billion":
         print(json.dumps(bench_billion_coef()))
         return
+    if a.config == "tiled":
+        print(json.dumps(bench_tiled_division()))
+        return
 
-    n = 200_000
-    data, raw = build_data(n=n, d_fixed=1024, n_users=20_000, d_re=32)
-    wall_tpu, _ = bench_tpu(raw)
+    n = 500_000
+    gx, y, ex, ids = build_data(n=n, d_fixed=1024, n_users=20_000, d_re=32)
+    fe_ds, re_ds = _glmix_datasets(gx, y, ex, ids)
+    wall_tpu, _ = bench_tpu(fe_ds, re_ds)
     examples_per_sec = n / wall_tpu
 
-    wall_cpu = bench_cpu_baseline(data, raw)
+    wall_cpu = bench_cpu_baseline(gx, y, ex, ids)
     vs_baseline = wall_cpu / wall_tpu
 
     print(
@@ -308,7 +419,7 @@ def main():
             {
                 "metric": "glmix_cd_sweep_examples_per_sec_per_chip",
                 "value": round(examples_per_sec, 1),
-                "unit": "examples/sec/chip (fixed d=1024 + per-user GLMix, 1 CD sweep)",
+                "unit": "examples/sec/chip (n=500k, fixed d=1024 + per-user GLMix, 1 CD sweep)",
                 "vs_baseline": round(vs_baseline, 2),
             }
         )

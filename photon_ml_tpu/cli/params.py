@@ -69,6 +69,9 @@ def parse_coordinate(spec: str) -> CoordinateConfig:
         down_sampling_rate=float(kv.pop("down.sampling.rate", 1.0)),
         variance_type=kv.pop("variance", "NONE").upper(),
     )
+    layout = kv.pop("layout", "auto").lower()
+    if layout not in ("auto", "dense", "ell", "sparse", "coo", "tiled"):
+        raise ValueError(f"unknown layout {layout!r} in coordinate {name!r}")
     cc = CoordinateConfig(
         name=name,
         feature_shard=shard,
@@ -77,6 +80,12 @@ def parse_coordinate(spec: str) -> CoordinateConfig:
         reg_weights=weights,
         active_cap=int(kv["active.cap"]) if "active.cap" in kv else None,
         active_lower_bound=int(kv.pop("active.lower.bound", 1)),
+        features_to_samples_ratio=(
+            float(kv.pop("features.to.samples.ratio"))
+            if "features.to.samples.ratio" in kv
+            else None
+        ),
+        layout=layout,
     )
     kv.pop("active.cap", None)
     if kv:
@@ -104,6 +113,24 @@ def add_common_io_args(p: argparse.ArgumentParser):
         default=None,
         help="directory of prebuilt index stores (FeatureIndexingDriver output)",
     )
+
+
+def parse_mesh_shape(spec: Optional[str]):
+    """``data=4,model=2`` -> a device Mesh (None/'' -> no mesh: single-device).
+
+    The driver-side entry to the parallel runtime: data axis shards sample
+    rows and entity blocks, model axis shards the coefficient dim of
+    ``layout=tiled`` coordinates (SURVEY.md §2.1 P1/P5/P13)."""
+    if not spec:
+        return None
+    from ..parallel.mesh import make_mesh
+
+    kv = parse_kv(spec)
+    n_data = int(kv.pop("data", 1))
+    n_model = int(kv.pop("model", 1))
+    if kv:
+        raise ValueError(f"unknown mesh keys: {sorted(kv)}")
+    return make_mesh(n_data=n_data, n_model=n_model)
 
 
 def build_shard_configs(args) -> Dict[str, FeatureShardConfig]:

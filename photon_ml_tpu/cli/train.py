@@ -36,7 +36,12 @@ from ..tuning.rescaling import HyperparameterConfig, ParamRange
 from ..tuning.tuner import get_tuner
 from ..utils.logging import setup_logging
 from ..utils.stats import compute_feature_statistics, save_feature_statistics
-from .params import add_common_io_args, build_shard_configs, parse_coordinate
+from .params import (
+    add_common_io_args,
+    build_shard_configs,
+    parse_coordinate,
+    parse_mesh_shape,
+)
 
 logger = logging.getLogger("photon_ml_tpu")
 
@@ -90,6 +95,12 @@ def build_parser() -> argparse.ArgumentParser:
         choices=["NONE", "RANDOM", "BAYESIAN"],
     )
     p.add_argument("--hyper-parameter-tuning-iter", type=int, default=10)
+    p.add_argument(
+        "--mesh-shape",
+        default="",
+        help="device mesh, e.g. data=4,model=2: data axis shards rows/entities, "
+        "model axis shards the coefficient dim of layout=tiled coordinates",
+    )
     p.add_argument("--log-file", default=None)
     p.add_argument("--log-level", default="INFO")
     return p
@@ -166,6 +177,7 @@ def run(argv: Optional[List[str]] = None) -> Dict:
             cc.regularize_by_prior = True
 
     evaluators = [e for e in args.evaluators.split(",") if e]
+    mesh = parse_mesh_shape(args.mesh_shape)
     estimator = GameEstimator(
         task=args.task,
         coordinate_configs=coords,
@@ -174,6 +186,7 @@ def run(argv: Optional[List[str]] = None) -> Dict:
         partial_retrain_locked=[
             c for c in args.partial_retrain_locked.split(",") if c
         ],
+        mesh=mesh,
     )
     results = estimator.fit(raw, validation=validation, initial_model=initial_model)
 
@@ -245,6 +258,7 @@ def _run_tuning(args, estimator, raw, validation, coords, prior_results):
             n_cd_iterations=args.coordinate_descent_iterations,
             evaluator_specs=[e for e in args.evaluators.split(",") if e],
             partial_retrain_locked=list(estimator.partial_retrain_locked),
+            mesh=estimator.mesh,
         )
         r = est.fit(raw, validation=validation)[0]
         results.append(r)

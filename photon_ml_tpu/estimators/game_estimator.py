@@ -35,6 +35,12 @@ from ..game.problem import GLMOptimizationConfig
 from ..io.data import RawDataset
 from ..models.game import FixedEffectModel, GameModel, RandomEffectModel
 from ..ops.normalization import NormalizationContext
+from ..utils.events import (
+    EventEmitter,
+    OptimizationLogEvent,
+    TrainingFinishEvent,
+    TrainingStartEvent,
+)
 from ..utils.timed import timed
 
 logger = logging.getLogger("photon_ml_tpu")
@@ -52,6 +58,12 @@ class CoordinateConfig:
     reg_weights: Sequence[float] = ()  # grid; empty -> [config.reg_weight]
     active_cap: Optional[int] = None
     active_lower_bound: int = 1
+    # Pearson feature selection: keep ceil(ratio * n_rows) features per entity
+    # (numFeaturesToSamplesRatioUpperBound, RandomEffectDataset.scala:553-565)
+    features_to_samples_ratio: Optional[float] = None
+    # fixed-effect batch layout: auto|dense|ell|coo|tiled ('tiled' shards the
+    # coefficient dim over the estimator mesh's model axis — the huge-d path)
+    layout: str = "auto"
     normalization: Optional[NormalizationContext] = None
     # incremental training: L2-regularize toward the warm-start model
     # ("Regularize by Previous Model During Warm-Start Training")
@@ -73,7 +85,10 @@ class GameResult:
     trackers: Dict[str, object]
 
 
-class GameEstimator:
+class GameEstimator(EventEmitter):
+    """Emits TrainingStart/OptimizationLog/TrainingFinish events to registered
+    listeners (EventEmitter.scala semantics; the reference's telemetry hook)."""
+
     def __init__(
         self,
         task: str,
@@ -83,7 +98,9 @@ class GameEstimator:
         dtype=jnp.float32,
         partial_retrain_locked: Sequence[str] = (),
         entity_pad_multiple: int = 1,
+        mesh=None,
     ):
+        super().__init__()
         if not coordinate_configs:
             raise ValueError("need at least one coordinate configuration")
         names = [c.name for c in coordinate_configs]
@@ -95,10 +112,28 @@ class GameEstimator:
         self.evaluator_specs = list(evaluator_specs)
         self.dtype = dtype
         self.partial_retrain_locked = set(partial_retrain_locked)
+        self.mesh = mesh
+        if mesh is not None and entity_pad_multiple == 1:
+            # entity blocks shard over the data axis: pad to its size
+            from ..parallel.mesh import DATA_AXIS
+
+            entity_pad_multiple = mesh.shape[DATA_AXIS]
         self.entity_pad_multiple = entity_pad_multiple
         unknown = self.partial_retrain_locked - set(names)
         if unknown:
             raise ValueError(f"locked coordinates not in configs: {sorted(unknown)}")
+        for cc in self.coordinate_configs:
+            if cc.layout == "tiled":
+                if mesh is None:
+                    raise ValueError(
+                        f"coordinate {cc.name}: layout='tiled' requires the "
+                        "estimator to be built with a device mesh"
+                    )
+                if cc.normalization is not None:
+                    raise ValueError(
+                        f"coordinate {cc.name}: normalization is not supported "
+                        "with the tiled layout (stats live in the unpadded space)"
+                    )
 
     # -- dataset preparation -------------------------------------------------
 
@@ -107,7 +142,7 @@ class GameEstimator:
         for cc in self.coordinate_configs:
             with timed(f"prepare dataset {cc.name}"):
                 if cc.is_random_effect:
-                    datasets[cc.name] = build_random_effect_dataset(
+                    ds = build_random_effect_dataset(
                         raw,
                         cc.name,
                         cc.feature_shard,
@@ -116,11 +151,31 @@ class GameEstimator:
                         active_lower_bound=cc.active_lower_bound,
                         dtype=self.dtype,
                         pad_entities_to_multiple=self.entity_pad_multiple,
+                        features_to_samples_ratio=cc.features_to_samples_ratio,
                     )
+                    if self.mesh is not None:
+                        from ..parallel.mesh import shard_entity_blocks
+
+                        ds = dataclasses.replace(
+                            ds, blocks=shard_entity_blocks(ds.blocks, self.mesh)
+                        )
+                    datasets[cc.name] = ds
                 else:
-                    datasets[cc.name] = build_fixed_effect_dataset(
-                        raw, cc.name, cc.feature_shard, dtype=self.dtype
+                    ds = build_fixed_effect_dataset(
+                        raw,
+                        cc.name,
+                        cc.feature_shard,
+                        dtype=self.dtype,
+                        layout=cc.layout,
+                        mesh=self.mesh,
                     )
+                    if self.mesh is not None and cc.layout != "tiled":
+                        from ..parallel.mesh import shard_batch
+
+                        ds = dataclasses.replace(
+                            ds, batch=shard_batch(ds.batch, self.mesh)
+                        )
+                    datasets[cc.name] = ds
         return datasets
 
     def _validation_context(
@@ -217,6 +272,9 @@ class GameEstimator:
         prev_models: Dict[str, object] = dict(
             (initial_model.models if initial_model else {})
         )
+        import time as _time
+
+        self.send_event(TrainingStartEvent(time=_time.time()))
         for combo in itertools.product(*grids):
             reg_weights = dict(zip(names, combo))
             coords = self._make_coordinates(datasets, reg_weights, prev_models)
@@ -233,8 +291,20 @@ class GameEstimator:
                     trackers=out.trackers,
                 )
             )
+            self.send_event(
+                OptimizationLogEvent(
+                    reg_weights=reg_weights,
+                    trackers=out.trackers,
+                    metrics=(
+                        None
+                        if out.best_evaluation is None
+                        else dict(out.best_evaluation.metrics)
+                    ),
+                )
+            )
             # warm start next config from this one (GameEstimator.scala:356-374)
             prev_models = dict(out.model.models)
+        self.send_event(TrainingFinishEvent(time=_time.time()))
         return results
 
     def select_best(self, results: Sequence[GameResult]) -> GameResult:

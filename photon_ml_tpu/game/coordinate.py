@@ -82,6 +82,13 @@ class FixedEffectCoordinate(Coordinate):
     ) -> Tuple[FixedEffectModel, SolverResult]:
         batch = self.dataset.batch
         if residual_scores is not None:
+            # residual scores live in true sample space; padded batch rows
+            # (mesh row multiples) carry zero residual
+            n_pad = batch.n_rows - residual_scores.shape[0]
+            if n_pad > 0:
+                residual_scores = jnp.concatenate(
+                    [residual_scores, jnp.zeros((n_pad,), residual_scores.dtype)]
+                )
             batch = batch.with_offsets(batch.offsets + residual_scores)
         if self.config.down_sampling_rate < 1.0:
             # runWithSampling (DistributedOptimizationProblem.scala:155-170)
@@ -97,13 +104,32 @@ class FixedEffectCoordinate(Coordinate):
         glm, result = problem.run(
             batch, initial_model=initial_model.model if initial_model else None
         )
+        # models live in the shard's TRUE feature space: trim any mesh padding
+        d_true = self.dataset.dim
+        if glm.coefficients.means.shape[0] > d_true:
+            glm = dataclasses.replace(
+                glm,
+                coefficients=Coefficients(
+                    means=glm.coefficients.means[:d_true],
+                    variances=None
+                    if glm.coefficients.variances is None
+                    else glm.coefficients.variances[:d_true],
+                ),
+            )
         return (
             FixedEffectModel(model=glm, feature_shard=self.dataset.feature_shard),
             result,
         )
 
     def score(self, model: FixedEffectModel) -> Array:
-        return model.score(self.dataset.batch)
+        feats = self.dataset.batch.features
+        means = model.model.coefficients.means
+        d_pad = feats.dim - means.shape[0]
+        if d_pad > 0:
+            means = jnp.concatenate([means, jnp.zeros((d_pad,), means.dtype)])
+        scores = feats.matvec(means)
+        n_true = self.dataset.n_rows
+        return scores[:n_true] if scores.shape[0] > n_true else scores
 
 
 @dataclasses.dataclass

@@ -48,15 +48,26 @@ Array = jax.Array
 
 @dataclasses.dataclass(frozen=True)
 class FixedEffectDataset:
-    """All samples' features from one shard (FixedEffectDataset.scala:26-152)."""
+    """All samples' features from one shard (FixedEffectDataset.scala:26-152).
+
+    ``true_dim`` / ``true_n_rows`` are the UNPADDED shard dimension and sample
+    count: mesh-tiled layouts pad both to device multiples, but models and
+    exchanged score vectors live in the true space (trim/pad happens at the
+    coordinate boundary)."""
 
     coordinate_id: str
     feature_shard: str
     batch: LabeledBatch
+    true_dim: Optional[int] = None
+    true_n_rows: Optional[int] = None
 
     @property
     def n_rows(self) -> int:
-        return self.batch.n_rows
+        return self.true_n_rows if self.true_n_rows is not None else self.batch.n_rows
+
+    @property
+    def dim(self) -> int:
+        return self.true_dim if self.true_dim is not None else self.batch.dim
 
 
 @jax.tree_util.register_dataclass
@@ -143,12 +154,71 @@ def build_fixed_effect_dataset(
     feature_shard: str,
     dtype=jnp.float32,
     layout: str = "auto",
+    mesh=None,
 ) -> FixedEffectDataset:
     return FixedEffectDataset(
         coordinate_id=coordinate_id,
         feature_shard=feature_shard,
-        batch=raw.to_batch(feature_shard, dtype=dtype, layout=layout),
+        batch=raw.to_batch(feature_shard, dtype=dtype, layout=layout, mesh=mesh),
+        true_dim=raw.shard_dims[feature_shard],
+        true_n_rows=raw.n_rows,
     )
+
+
+def _pearson_keep_mask(
+    feats: np.ndarray,  # f8[E, K, S] zero-padded per-entity features
+    labels: np.ndarray,  # f8[E, K]
+    row_mask: np.ndarray,  # bool[E, K] filled (active) slots
+    proj_cols: np.ndarray,  # i32[E, S], -1 = padding
+    ratio: float,
+) -> np.ndarray:
+    """Per-entity Pearson-correlation feature selection, vectorized over all
+    entities at once.
+
+    Reference: LocalDataset.filterFeaturesByPearsonCorrelationScore
+    (photon-api .../data/LocalDataset.scala:103-130) keeps, per entity, the
+    ceil(ratio * n_rows) features with the largest |Pearson(feature, label)|
+    (stable one-pass scores, :180-258), where a constant feature with value
+    1.0 — the intercept — scores 1.0 (first such column only) and other
+    constant features score 0. Selection only applies when it would shrink
+    the entity's active feature set.
+
+    Returns bool[E, S]: True = keep the column.
+    """
+    E, K, S = feats.shape
+    EPS = np.finfo(np.float64).eps
+    n_e = row_mask.sum(axis=1)  # rows per entity
+    n_safe = np.maximum(n_e, 1).astype(np.float64)
+
+    mean_y = (labels * row_mask).sum(axis=1) / n_safe
+    dy = (labels - mean_y[:, None]) * row_mask
+    std_y = np.sqrt((dy * dy).sum(axis=1))
+
+    mean_x = (feats * row_mask[:, :, None]).sum(axis=1) / n_safe[:, None]
+    dx = (feats - mean_x[:, None, :]) * row_mask[:, :, None]
+    cov = np.einsum("eks,ek->es", dx, dy)
+    std_x = np.sqrt((dx * dx).sum(axis=1))  # sum over K -> [E, S]
+    score = cov / (std_y[:, None] * std_x + EPS)
+
+    # constant columns: intercept (value 1.0, first occurrence) scores 1.0,
+    # any other constant scores 0 (LocalDataset.scala:225-236)
+    const = std_x < np.sqrt(n_safe)[:, None] * EPS
+    cand = const & (np.abs(mean_x - 1.0) < 1e-12) & (proj_cols >= 0)
+    first_one = np.zeros_like(cand)
+    has = cand.any(axis=1)
+    first_one[np.nonzero(has)[0], np.argmax(cand, axis=1)[has]] = True
+    score = np.where(const, np.where(first_one, 1.0, 0.0), score)
+
+    n_active = (proj_cols >= 0).sum(axis=1)
+    k_keep = np.ceil(ratio * n_e).astype(np.int64)
+    k_keep = np.where(k_keep < n_active, k_keep, n_active)
+
+    # rank columns by descending |score| (stable: earlier column wins ties)
+    absc = np.where(proj_cols >= 0, np.abs(score), -1.0)
+    order = np.argsort(-absc, axis=1, kind="stable")
+    rank = np.empty((E, S), dtype=np.int64)
+    np.put_along_axis(rank, order, np.broadcast_to(np.arange(S), (E, S)), axis=1)
+    return (rank < k_keep[:, None]) & (proj_cols >= 0)
 
 
 def build_random_effect_dataset(
@@ -161,12 +231,16 @@ def build_random_effect_dataset(
     seed: int = 0,
     dtype=jnp.float32,
     pad_entities_to_multiple: int = 1,
+    features_to_samples_ratio: Optional[float] = None,
 ) -> RandomEffectDataset:
     """Host-side dataset build (the one-time "shuffle" of SURVEY.md §2.1 P13).
 
     active_cap: numActiveDataPointsUpperBound — reservoir-cap per entity with
     count/cap weight rescale. active_lower_bound: numActiveDataPointsLowerBound
     — entities with fewer samples are not trained.
+    features_to_samples_ratio: numFeaturesToSamplesRatioUpperBound — per
+    entity, keep only the ceil(ratio * n_rows) features with the largest
+    |Pearson(feature, label)| (RandomEffectDataset.scala:553-565).
     """
     n = raw.n_rows
     ids = raw.id_tags[random_effect_type]
@@ -268,6 +342,25 @@ def build_random_effect_dataset(
     # assignment order of the loop implementation)
     loc = np.searchsorted(uniq_keys, keys[aa, ff]) - key_starts[ae[aa]]
     feats[ae[aa], ak[aa], loc] = fv[aa, ff]
+
+    if features_to_samples_ratio is not None:
+        keep = _pearson_keep_mask(
+            feats, labels_b, active_rows_np >= 0, proj_cols_np,
+            features_to_samples_ratio,
+        )
+        # compact kept columns to the front (stable: column order preserved)
+        # and shrink the block S dim to the new max subspace size
+        order = np.argsort(~keep, axis=1, kind="stable")
+        proj_cols_np = np.take_along_axis(
+            np.where(keep, proj_cols_np, -1), order, axis=1
+        )
+        feats = np.take_along_axis(
+            np.where(keep[:, None, :], feats, 0.0), order[:, None, :], axis=2
+        )
+        per_entity_s = keep.sum(axis=1).astype(np.int64)
+        S = max(int(per_entity_s.max()) if E_real else 1, 1)
+        proj_cols_np = proj_cols_np[:, :S]
+        feats = feats[:, :, :S]
 
     blocks = EntityBlocks(
         features=jnp.asarray(feats, dtype),

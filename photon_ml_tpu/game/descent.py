@@ -23,6 +23,7 @@ import numpy as np
 
 from ..evaluation.suite import EvaluationResults, EvaluationSuite
 from ..models.game import GameModel
+from ..optimize.trackers import build_tracker
 from ..utils.timed import timed
 from .coordinate import Coordinate, ModelCoordinate
 
@@ -34,7 +35,9 @@ class CoordinateDescentResult:
     model: GameModel
     evaluations: List[Tuple[str, EvaluationResults]]  # (coordinate, results) per update
     best_evaluation: Optional[EvaluationResults]
-    trackers: Dict[str, object]  # coordinate -> last SolverResult
+    # coordinate -> Fixed/RandomEffectOptimizationTracker (raw SolverResult on
+    # tracker.result)
+    trackers: Dict[str, object]
 
 
 @dataclasses.dataclass
@@ -110,11 +113,20 @@ class CoordinateDescent:
                 residual = summed - own if own is not None else summed
 
                 with timed(f"cd iter {it} coordinate {name}: train"):
-                    model, tracker = coordinate.train(
+                    model, solver_result = coordinate.train(
                         residual, initial_model=models.get(name)
                     )
+                tracker = build_tracker(coordinate, solver_result)
                 if tracker is not None:
                     trackers[name] = tracker
+                    # logOptimizationSummary (CoordinateDescent.scala:230-248):
+                    # per-coordinate convergence histogram / iteration stats
+                    logger.info(
+                        "cd iter %d coordinate %s optimization summary:\n%s",
+                        it,
+                        name,
+                        tracker.to_summary_string(),
+                    )
                 models[name] = model
 
                 with timed(f"cd iter {it} coordinate {name}: score"):

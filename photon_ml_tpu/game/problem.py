@@ -29,6 +29,15 @@ from ..optimize import OptimizerConfig, SolverResult, optimize
 Array = jax.Array
 
 
+def _pad_dim(v: Array, dim: int, fill: float) -> Array:
+    """Zero/one-pad a [d] vector up to a mesh-padded feature dim."""
+    if v.shape[0] >= dim:
+        return v
+    return jnp.concatenate(
+        [v, jnp.full((dim - v.shape[0],), fill, dtype=v.dtype)]
+    )
+
+
 @dataclasses.dataclass(frozen=True)
 class GLMOptimizationConfig:
     """Per-coordinate optimization settings (reference:
@@ -75,6 +84,11 @@ class GLMProblem:
                 prior_precision = 1.0 / jnp.maximum(var, 1e-12)
             else:
                 prior_precision = jnp.ones_like(prior_mean)
+            # mesh-tiled batches pad the feature dim; padded coords have no
+            # data — prior mean 0 / precision 1 pins them at zero
+            prior_mean = _pad_dim(prior_mean, batch.dim, 0.0)
+            if prior_precision is not None:
+                prior_precision = _pad_dim(prior_precision, batch.dim, 1.0)
         return GLMObjective(
             loss=get_loss(self.task),
             batch=batch,
@@ -102,8 +116,18 @@ class GLMProblem:
             w0 = jnp.asarray(initial_model.coefficients.means, dtype)
             if self.normalization is not None:
                 w0 = self.normalization.model_to_transformed_space(w0)
+            w0 = _pad_dim(w0, batch.dim, 0.0)
         else:
             w0 = jnp.zeros(batch.dim, dtype)
+        mesh = getattr(batch.features, "mesh", None)
+        if mesh is not None:
+            # tiled batch: shard the coefficient vector over the model axis so
+            # every solver state array ([m, d] L-BFGS history included)
+            # inherits the partition instead of replicating d on one device
+            from ..parallel.sparse import MODEL_AXIS
+            from jax.sharding import NamedSharding, PartitionSpec
+
+            w0 = jax.device_put(w0, NamedSharding(mesh, PartitionSpec(MODEL_AXIS)))
 
         from ..ops.glm import hvp_fn, vg_fn
 

@@ -71,10 +71,14 @@ class RawDataset:
             uids=None if self.uids is None else self.uids[rows],
         )
 
-    def to_batch(self, shard: str, dtype=None, layout: str = "auto"):
+    def to_batch(self, shard: str, dtype=None, layout: str = "auto", mesh=None):
         """Build a device LabeledBatch for one feature shard.
 
-        layout: 'dense' | 'sparse' | 'auto' (dense when d <= 4096).
+        layout: 'auto' (dense when d <= 4096, else ELL) | 'dense' |
+        'ell' (alias 'sparse': row-major padded sparse, moderate d) |
+        'coo' (column-sorted COO, huge d single-device) |
+        'tiled' ((data x model)-mesh-tiled sparse, huge d sharded; requires
+        ``mesh`` — see parallel/sparse.py).
         """
         import jax.numpy as jnp
 
@@ -85,13 +89,29 @@ class RawDataset:
         rows, cols, vals = self.shard_coo[shard]
         d = self.shard_dims[shard]
         if layout == "auto":
-            layout = "dense" if d <= 4096 else "sparse"
+            layout = "dense" if d <= 4096 else "ell"
         if layout == "dense":
             x = np.zeros((self.n_rows, d), dtype=np.float64)
             x[rows, cols] = vals
             return batch_from_dense(x, self.labels, self.offsets, self.weights, dtype=dtype)
-        return batch_from_coo(
-            rows, cols, vals, self.labels, d, self.offsets, self.weights, dtype=dtype
+        if layout in ("ell", "sparse", "coo"):
+            return batch_from_coo(
+                rows, cols, vals, self.labels, d, self.offsets, self.weights,
+                dtype=dtype,
+                layout="coo" if layout == "coo" else "ell",
+            )
+        if layout == "tiled":
+            if mesh is None:
+                raise ValueError("layout='tiled' requires a device mesh")
+            from ..parallel.sparse import tiled_sparse_batch
+
+            return tiled_sparse_batch(
+                rows, cols, vals, self.labels, d, mesh,
+                offsets=self.offsets, weights=self.weights, dtype=dtype,
+            )
+        raise ValueError(
+            f"unknown batch layout {layout!r}: expected "
+            "auto|dense|ell|sparse|coo|tiled"
         )
 
 

@@ -283,7 +283,11 @@ def batch_from_coo(
         k = max(k, 1)
         idx = np.zeros((n, k), dtype=np.int32)
         val = np.zeros((n, k), dtype=np.float64)
-        order = np.lexsort((cols, rows))
+        # stable row sort preserves input order within each row, so max_nnz
+        # truncation keeps the FIRST entries in input order (matching the
+        # documented contract; a column sort here would silently keep the
+        # lowest-column entries instead)
+        order = np.argsort(rows, kind="stable")
         r_s, c_s, v_s = rows[order], cols[order], vals[order]
         starts = np.cumsum(np.concatenate([[0], np.bincount(r_s, minlength=n)[:-1]]))
         within = np.arange(len(r_s)) - starts[r_s]

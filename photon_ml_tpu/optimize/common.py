@@ -56,8 +56,10 @@ class OptimizerConfig:
 
     Defaults are the reference's (LBFGS.scala:149-154, TRON.scala:252-258).
     ``l1_weight`` routes LBFGS -> OWL-QN (reference: OptimizerFactory.scala:30-74).
-    ``box_constraints`` = (lower[d], upper[d]) applied by projection after each
-    accepted step (reference: OptimizationUtils.projectCoefficientsToSubspace).
+    ``box_constraints`` = (lower[d], upper[d]): LBFGS/LBFGSB run the
+    gradient-projection L-BFGS-B scheme (projected gradient + projected
+    line-search trials, lbfgs.py; reference LBFGSB.scala:39-92); TRON projects
+    after each accepted step (OptimizationUtils.projectCoefficientsToSubspace).
     """
 
     optimizer_type: OptimizerType = OptimizerType.LBFGS

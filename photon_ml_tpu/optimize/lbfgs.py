@@ -13,8 +13,13 @@ single jit/vmap-safe implementation:
   orthant-constrained line-search steps; the correction pairs use the plain
   gradient, convergence uses the pseudo-gradient — matching the OWL-QN
   algorithm the reference delegates to Breeze for;
-- optional box constraints applied by projection after each accepted step
-  (reference: LBFGS.scala's constraint handling + OptimizationUtils.scala:34-66).
+- box constraints (L-BFGS-B, reference LBFGSB.scala:39-92): gradient
+  projection — the "gradient" driving the two-loop direction and the
+  convergence test is the projected gradient w - P(w - g), which vanishes
+  exactly at bound-held coordinates — with every line-search trial point
+  projected onto the box and Armijo measured on the actual displacement
+  f(P(w + t*d)) <= f + c1*g.(w_t - w). Unlike clamp-after-step this
+  converges to the constrained KKT point when bounds are active.
 
 Every lane of state carries a ``done`` flag; once set, all updates become
 no-ops, which is what makes ``jax.vmap(solve_lbfgs, ...)`` correct for the
@@ -35,7 +40,6 @@ from .common import (
     ValueAndGradFn,
     as_partial,
     check_convergence,
-    project_box,
 )
 
 Array = jax.Array
@@ -122,12 +126,19 @@ def _line_search(
     l1: float,
     orthant: Optional[Array],
     max_iters: int,
+    box: Optional[Tuple[Array, Array]] = None,
+    g_plain: Optional[Array] = None,
 ) -> Tuple[Array, Array, Array, Array]:
     """Strong-Wolfe bisection line search; returns (w_new, f_new, g_new, success).
 
     For OWL-QN (orthant is not None) each trial point is projected onto the
     orthant and only the Armijo condition is enforced (standard OWL-QN
     backtracking); f and dg then refer to the l1-augmented objective.
+
+    For L-BFGS-B (box is not None) each trial point is projected onto the box
+    and Armijo is measured on the actual displacement
+    f_t <= f + c1 * g.(w_t - w) (projected-gradient line search), again with
+    no curvature condition.
     """
     dtype = w.dtype
     inf = jnp.asarray(jnp.inf, dtype)
@@ -136,6 +147,8 @@ def _line_search(
         w_t = w + t * direction
         if orthant is not None:
             w_t = jnp.where(w_t * orthant < 0, 0.0, w_t)
+        if box is not None:
+            w_t = jnp.clip(w_t, box[0], box[1])
         f_t, g_t = value_and_grad(w_t)
         if l1 > 0.0:
             f_t = f_t + l1 * jnp.sum(jnp.abs(w_t))
@@ -159,8 +172,11 @@ def _line_search(
         return jnp.logical_not(s.done)
 
     def body(s: _LineSearchState):
-        armijo_ok = s.f_t <= f + _C1 * s.t * dg
-        if orthant is None:
+        if box is not None:
+            armijo_ok = s.f_t <= f + _C1 * jnp.dot(g_plain, s.w_t - w)
+        else:
+            armijo_ok = s.f_t <= f + _C1 * s.t * dg
+        if orthant is None and box is None:
             # weak Wolfe (Lewis-Overton bisection scheme): convergent under pure
             # bisection/expansion and still guarantees s.y > 0 for the history
             curv_ok = jnp.dot(s.g_t, direction) >= _C2 * dg
@@ -249,12 +265,21 @@ def _solve(
             f = f + l1 * jnp.sum(jnp.abs(w))
         return f, g
 
+    if box is not None:
+        w0 = jnp.clip(w0, box[0], box[1])  # start feasible
     f0, g0 = full_objective(w0)
 
     hist = jnp.full((max_iterations + 1,), jnp.nan, dtype)
 
     def effective_grad(w, g):
-        return _pseudo_gradient(w, g, l1) if l1 > 0.0 else g
+        if l1 > 0.0:
+            return _pseudo_gradient(w, g, l1)
+        if box is not None:
+            # projected gradient: zero at bound-held coordinates, so both the
+            # quasi-Newton direction and the convergence test respect the
+            # active set (LBFGSB.scala:39-92 semantics)
+            return w - jnp.clip(w - g, box[0], box[1])
+        return g
 
     pg0 = effective_grad(w0, g0)
 
@@ -295,11 +320,8 @@ def _solve(
 
         w_new, f_new, g_new, ls_ok = _line_search(
             value_and_grad, s.w, s.f, direction, dg, l1, orthant,
-            max_line_search_iterations,
+            max_line_search_iterations, box=box, g_plain=s.g,
         )
-        if box is not None:
-            w_new = project_box(w_new, box)
-            f_new, g_new = full_objective(w_new)
 
         improved = ls_ok & (f_new < s.f)
 

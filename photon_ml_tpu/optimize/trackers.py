@@ -1,0 +1,119 @@
+"""Optimization trackers: per-coordinate solve summaries for logging.
+
+Reference: photon-api .../optimization/FixedEffectOptimizationTracker.scala:31
+(wraps one solve's state history), RandomEffectOptimizationTracker.scala
+(aggregates the per-entity solves: convergence-reason histogram + iteration
+StatCounter; time-per-entity stats do not exist here because all entities
+advance in LOCKSTEP through one vmapped solver — wall-clock is a property of
+the whole block, which the Timed sections already record), and
+CoordinateDescent.logOptimizationSummary (photon-lib
+.../algorithm/CoordinateDescent.scala:230-248).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Dict, Optional
+
+import numpy as np
+
+from .common import ConvergenceReason, SolverResult
+
+
+@dataclasses.dataclass(frozen=True)
+class StatCounter:
+    """Spark StatCounter equivalent: count/mean/stdev/max/min of a sample."""
+
+    count: int
+    mean: float
+    stdev: float
+    max: float
+    min: float
+
+    @classmethod
+    def of(cls, a: np.ndarray) -> "StatCounter":
+        a = np.asarray(a, dtype=np.float64).ravel()
+        if a.size == 0:
+            return cls(0, 0.0, 0.0, 0.0, 0.0)
+        return cls(
+            count=int(a.size),
+            mean=float(a.mean()),
+            stdev=float(a.std()),
+            max=float(a.max()),
+            min=float(a.min()),
+        )
+
+    def __str__(self) -> str:
+        return (
+            f"(count: {self.count}, mean: {self.mean:.6g}, "
+            f"stdev: {self.stdev:.6g}, max: {self.max:.6g}, min: {self.min:.6g})"
+        )
+
+
+@dataclasses.dataclass(frozen=True)
+class FixedEffectOptimizationTracker:
+    """One whole-dataset solve (FixedEffectOptimizationTracker.scala:31)."""
+
+    result: SolverResult
+
+    def to_summary_string(self) -> str:
+        r = self.result
+        reason = ConvergenceReason(int(np.asarray(r.reason))).name
+        losses = np.asarray(r.loss_history, dtype=np.float64)
+        losses = losses[np.isfinite(losses)]
+        return (
+            f"Convergence reason: {reason}\n"
+            f"Iterations: {int(np.asarray(r.iterations))}\n"
+            f"Loss: {float(np.asarray(r.loss)):.6g}"
+            + (f" (initial {losses[0]:.6g})" if losses.size else "")
+        )
+
+
+@dataclasses.dataclass(frozen=True)
+class RandomEffectOptimizationTracker:
+    """Aggregate of the vmapped per-entity solves
+    (RandomEffectOptimizationTracker.scala: convergence-reason counts +
+    iteration stats over entities)."""
+
+    result: SolverResult
+    convergence_reasons: Dict[str, int]
+    iterations_stats: StatCounter
+
+    @classmethod
+    def from_result(
+        cls, result: SolverResult, entity_mask: Optional[np.ndarray] = None
+    ) -> "RandomEffectOptimizationTracker":
+        reasons = np.asarray(result.reason).ravel()
+        iters = np.asarray(result.iterations).ravel()
+        if entity_mask is not None:
+            mask = np.asarray(entity_mask, dtype=bool).ravel()
+            reasons, iters = reasons[mask], iters[mask]
+        uniq, counts = np.unique(reasons, return_counts=True)
+        hist = {
+            ConvergenceReason(int(u)).name: int(c) for u, c in zip(uniq, counts)
+        }
+        return cls(
+            result=result,
+            convergence_reasons=hist,
+            iterations_stats=StatCounter.of(iters),
+        )
+
+    def to_summary_string(self) -> str:
+        return (
+            f"Convergence reasons stats: {self.convergence_reasons}\n"
+            f"Number of iterations stats: {self.iterations_stats}"
+        )
+
+
+def build_tracker(coordinate, result: Optional[SolverResult]):
+    """SolverResult -> the right tracker for a coordinate (None for locked
+    ModelCoordinates, which never train)."""
+    if result is None:
+        return None
+    reasons = np.asarray(result.reason)
+    if reasons.ndim == 0:
+        return FixedEffectOptimizationTracker(result=result)
+    dataset = getattr(coordinate, "dataset", None)
+    counts = getattr(dataset, "entity_counts", None)
+    mask = None if counts is None else np.asarray(counts)[: reasons.shape[0]] > 0
+    return RandomEffectOptimizationTracker.from_result(result, entity_mask=mask)

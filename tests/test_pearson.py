@@ -1,0 +1,117 @@
+"""Pearson-correlation feature selection (VERDICT r2 item 5).
+
+Reference: LocalDataset.filterFeaturesByPearsonCorrelationScore
+(photon-api .../data/LocalDataset.scala:103-130) + the stable one-pass score
+(:180-258), wired as numFeaturesToSamplesRatioUpperBound
+(RandomEffectDataset.scala:553-565).
+"""
+
+import numpy as np
+import pytest
+import scipy.stats
+
+from photon_ml_tpu.game.data import _pearson_keep_mask, build_random_effect_dataset
+from photon_ml_tpu.testing import generate_mixed_effect_data
+from photon_ml_tpu.testing.generators import mixed_data_to_raw_dataset
+
+
+def test_pearson_scores_match_scipy():
+    """The internal score ranking must agree with scipy.stats.pearsonr."""
+    rng = np.random.default_rng(4)
+    E, K, S = 3, 40, 6
+    feats = rng.normal(size=(E, K, S))
+    feats[:, :, -1] = 1.0  # intercept column
+    labels = rng.normal(size=(E, K)) + 0.8 * feats[:, :, 0]  # col 0 informative
+    row_mask = np.ones((E, K), dtype=bool)
+    proj_cols = np.tile(np.arange(S, dtype=np.int32), (E, 1))
+
+    # keep exactly 3 columns per entity: the 2 highest-|pearson| + intercept
+    keep = _pearson_keep_mask(feats, labels, row_mask, proj_cols, ratio=3 / K)
+    assert keep.sum(axis=1).tolist() == [3, 3, 3]
+    for e in range(E):
+        scores = np.asarray(
+            [
+                abs(scipy.stats.pearsonr(feats[e, :, j], labels[e]).statistic)
+                for j in range(S - 1)
+            ]
+        )
+        expected = set(np.argsort(-scores, kind="stable")[:2]) | {S - 1}
+        assert set(np.nonzero(keep[e])[0]) == expected  # intercept scores 1.0
+
+
+def test_pearson_partial_rows_and_constant_columns():
+    rng = np.random.default_rng(5)
+    E, K, S = 2, 30, 5
+    feats = rng.normal(size=(E, K, S))
+    feats[:, :, 2] = 7.0  # constant non-intercept => score 0
+    feats[:, :, 4] = 1.0  # intercept => score 1
+    labels = feats[:, :, 0] + 0.01 * rng.normal(size=(E, K))
+    row_mask = np.zeros((E, K), dtype=bool)
+    row_mask[:, :20] = True  # only 20 active rows
+    feats[~row_mask] = 0.0
+    labels[~row_mask] = 0.0
+    proj_cols = np.tile(np.arange(S, dtype=np.int32), (E, 1))
+
+    keep = _pearson_keep_mask(feats, labels, row_mask, proj_cols, ratio=3 / 20)
+    for e in range(E):
+        kept = set(np.nonzero(keep[e])[0])
+        assert 0 in kept  # the informative column
+        assert 4 in kept  # the intercept
+        assert 2 not in kept  # constant non-intercept scores 0
+
+
+def test_pearson_keeps_all_when_ratio_large():
+    rng = np.random.default_rng(6)
+    feats = rng.normal(size=(2, 10, 4))
+    labels = rng.normal(size=(2, 10))
+    row_mask = np.ones((2, 10), dtype=bool)
+    proj_cols = np.tile(np.arange(4, dtype=np.int32), (2, 1))
+    keep = _pearson_keep_mask(feats, labels, row_mask, proj_cols, ratio=10.0)
+    assert keep.all()
+
+
+def test_re_build_pearson_shrinks_wide_shard():
+    """Integration: a wide per-entity shard shrinks under the ratio bound and
+    the surviving subspace still trains."""
+    import dataclasses as dc
+
+    import jax.numpy as jnp
+
+    from photon_ml_tpu.game import GLMOptimizationConfig, RandomEffectCoordinate
+    from photon_ml_tpu.ops.regularization import RegularizationContext
+    from photon_ml_tpu.optimize import OptimizerConfig
+
+    raw = mixed_data_to_raw_dataset(
+        generate_mixed_effect_data(
+            n=600, d_fixed=4, re_specs={"userId": (20, 16)}, seed=8
+        )
+    )
+    ds_full = build_random_effect_dataset(raw, "re", "userShard", "userId")
+    ds_sel = build_random_effect_dataset(
+        raw, "re", "userShard", "userId", features_to_samples_ratio=0.05
+    )
+    S_full = ds_full.blocks.proj_cols.shape[1]
+    S_sel = ds_sel.blocks.proj_cols.shape[1]
+    assert S_sel < S_full
+    # per-entity: ceil(ratio * n_e) features kept (bounded by the full set)
+    counts = np.asarray(ds_sel.entity_counts)
+    kept = np.asarray(ds_sel.entity_subspace_dims)
+    full = np.asarray(ds_full.entity_subspace_dims)
+    np.testing.assert_array_equal(
+        kept, np.minimum(np.ceil(0.05 * counts).astype(int), full)
+    )
+    # kept columns are a subset of the full subspace, per entity
+    for e in range(ds_sel.num_entities):
+        sel_cols = set(np.asarray(ds_sel.blocks.proj_cols[e]))
+        full_cols = set(np.asarray(ds_full.blocks.proj_cols[e]))
+        assert sel_cols - {-1} <= full_cols - {-1}
+
+    cfg = GLMOptimizationConfig(
+        optimizer=OptimizerConfig(tolerance=1e-7, max_iterations=20),
+        regularization=RegularizationContext("L2"),
+        reg_weight=1.0,
+    )
+    model, res = RandomEffectCoordinate(
+        dataset=ds_sel, task="logistic_regression", config=cfg
+    ).train(None)
+    assert np.isfinite(np.asarray(model.coef_values)).all()
