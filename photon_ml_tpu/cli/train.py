@@ -96,6 +96,27 @@ def build_parser() -> argparse.ArgumentParser:
     )
     p.add_argument("--hyper-parameter-tuning-iter", type=int, default=10)
     p.add_argument(
+        "--hyper-parameter-config",
+        default=None,
+        help="JSON tuning config (HyperparameterSerialization.configFromJson "
+        "shape: tuning_mode + variables map); overrides the default "
+        "per-coordinate log-reg-weight ranges",
+    )
+    p.add_argument(
+        "--hyper-parameter-prior",
+        default=None,
+        help="JSON prior observations ({'records': [...]}) used to shrink the "
+        "search range around the GP-predicted best prior candidate "
+        "(ShrinkSearchRange.getBounds)",
+    )
+    p.add_argument(
+        "--hyper-parameter-shrink-radius",
+        type=float,
+        default=0.25,
+        help="unit-cube radius of the shrunk search range around the best "
+        "prior candidate",
+    )
+    p.add_argument(
         "--mesh-shape",
         default="",
         help="device mesh, e.g. data=4,model=2: data axis shards rows/entities, "
@@ -232,20 +253,28 @@ def run(argv: Optional[List[str]] = None) -> Dict:
 
 def _run_tuning(args, estimator, raw, validation, coords, prior_results):
     """GP/random tuning over per-coordinate log10 reg weights
-    (GameEstimatorEvaluationFunction semantics: candidate <-> (log lambda,...))."""
+    (GameEstimatorEvaluationFunction semantics: candidate <-> (log lambda,...)).
+
+    The explicit grid results seed the tuner as observations
+    (GameTrainingDriver.scala:666 `convertObservations(models)`), so the GP
+    starts warm instead of re-exploring the grid. An optional JSON tuning
+    config overrides the search ranges; optional prior observations shrink
+    the range around the GP-predicted best (ShrinkSearchRange.getBounds).
+    """
+    from ..tuning import Observation, prior_to_json
+
     tunable = [cc.name for cc in coords if cc.name not in estimator.partial_retrain_locked]
-    hp = HyperparameterConfig(
-        params=[
-            ParamRange(name=f"{n}.reg_weight", min=1e-4, max=1e4, transform="LOG")
-            for n in tunable
-        ]
-    )
+    hp = _build_tuning_config(args, tunable)
+    names = [p.name for p in hp.params]
     higher_better = _higher_is_better(args.evaluators)
+    sign = -1.0 if higher_better else 1.0
     results: List[GameResult] = []
 
     def evaluate(unit_vec):
         native = hp.scale_up(unit_vec)
-        weights = dict(zip(tunable, native))
+        weights = {
+            n.removesuffix(".reg_weight"): float(v) for n, v in zip(names, native)
+        }
         import dataclasses as dc
 
         cfgs = []
@@ -264,16 +293,90 @@ def _run_tuning(args, estimator, raw, validation, coords, prior_results):
         results.append(r)
         metric = r.evaluation.primary_metric
         # the tuner minimizes; negate higher-is-better metrics
-        return (-metric if higher_better else metric), r
+        return sign * metric, r
+
+    # seed the tuner with the explicit-grid results (convertObservations)
+    observations = []
+    for r in prior_results or []:
+        if r.evaluation is None:
+            continue
+        observations.append(
+            Observation(
+                candidate=hp.scale_down(_native_vec(r, names)),
+                value=sign * r.evaluation.primary_metric,
+                artifact=r,
+            )
+        )
 
     tuner = get_tuner(args.hyper_parameter_tuning)
     tuner.search(
         args.hyper_parameter_tuning_iter,
         hp.dim,
         evaluate,
+        observations=observations,
+        discrete_params=hp.discrete_dims(),
         seed=0,
     )
+
+    # record every (grid + tuned) observation as a reusable prior file
+    priors = [
+        (_native_vec(r, names), r.evaluation.primary_metric)
+        for r in list(prior_results or []) + results
+        if r.evaluation is not None
+    ]
+    os.makedirs(args.output_dir, exist_ok=True)
+    with open(os.path.join(args.output_dir, "hyperparameter-prior.json"), "w") as f:
+        f.write(prior_to_json(names, priors))
     return results
+
+
+def _native_vec(result: GameResult, names: List[str]) -> np.ndarray:
+    """GameResult -> native hyperparameter vector ordered by `names`
+    (vectorizeParams semantics; names are '<coordinate>.reg_weight')."""
+    return np.asarray(
+        [result.config.get(n.removesuffix(".reg_weight"), 1.0) for n in names]
+    )
+
+
+def _build_tuning_config(args, tunable: List[str]) -> HyperparameterConfig:
+    """Default per-coordinate log-λ ranges, optionally overridden by a JSON
+    tuning config and shrunk around prior observations."""
+    from ..tuning import config_from_json, get_bounds
+
+    if args.hyper_parameter_config:
+        with open(args.hyper_parameter_config) as f:
+            _, hp = config_from_json(f.read())
+        tunable_names = {f"{n}.reg_weight" for n in tunable}
+        bad = [p.name for p in hp.params if p.name not in tunable_names]
+        if bad:
+            raise SystemExit(
+                f"--hyper-parameter-config variables {bad} do not name tunable "
+                f"coordinates; expected names among {sorted(tunable_names)}"
+            )
+    else:
+        hp = HyperparameterConfig(
+            params=[
+                ParamRange(name=f"{n}.reg_weight", min=1e-4, max=1e4, transform="LOG")
+                for n in tunable
+            ]
+        )
+    if args.hyper_parameter_prior:
+        import dataclasses as dc
+
+        with open(args.hyper_parameter_prior) as f:
+            lower, upper = get_bounds(
+                hp,
+                f.read(),
+                radius=args.hyper_parameter_shrink_radius,
+                higher_is_better=_higher_is_better(args.evaluators),
+            )
+        hp = HyperparameterConfig(
+            params=[
+                dc.replace(p, min=float(lo), max=float(hi))
+                for p, lo, hi in zip(hp.params, lower, upper)
+            ]
+        )
+    return hp
 
 
 def _higher_is_better(evaluators: str) -> bool:

@@ -3,6 +3,15 @@ from .gp import GaussianProcessEstimator, GaussianProcessModel, GaussianProcessP
 from .kernels import KERNELS, Matern52, RBF, StationaryKernel
 from .rescaling import HyperparameterConfig, ParamRange
 from .search import EvaluationFn, GaussianProcessSearch, Observation, RandomSearch
+from .serialization import (
+    TUNING_MODE_BAYESIAN,
+    TUNING_MODE_NONE,
+    TUNING_MODE_RANDOM,
+    config_from_json,
+    prior_from_json,
+    prior_to_json,
+)
+from .shrink import get_bounds
 from .slice_sampler import slice_sample
 from .tuner import (
     BayesianTuner,
@@ -34,4 +43,11 @@ __all__ = [
     "RandomTuner",
     "BayesianTuner",
     "get_tuner",
+    "config_from_json",
+    "prior_from_json",
+    "prior_to_json",
+    "get_bounds",
+    "TUNING_MODE_NONE",
+    "TUNING_MODE_RANDOM",
+    "TUNING_MODE_BAYESIAN",
 ]

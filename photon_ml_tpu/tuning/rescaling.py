@@ -15,6 +15,7 @@ import numpy as np
 
 TRANSFORM_NONE = "NONE"
 TRANSFORM_LOG = "LOG"
+TRANSFORM_SQRT = "SQRT"
 
 
 @dataclasses.dataclass(frozen=True)
@@ -22,27 +23,34 @@ class ParamRange:
     name: str
     min: float
     max: float
-    transform: str = TRANSFORM_NONE  # NONE | LOG (log10 space)
+    transform: str = TRANSFORM_NONE  # NONE | LOG (log10 space) | SQRT
     discrete: bool = False
+
+    def _fwd(self, v):
+        if self.transform == TRANSFORM_LOG:
+            return np.log10(v)
+        if self.transform == TRANSFORM_SQRT:
+            return np.sqrt(v)
+        return v
+
+    def _bwd(self, v):
+        if self.transform == TRANSFORM_LOG:
+            return 10.0 ** v
+        if self.transform == TRANSFORM_SQRT:
+            return v * v
+        return v
 
     def scale_up(self, unit: float) -> float:
         """[0,1] -> native."""
-        lo, hi = self.min, self.max
-        if self.transform == TRANSFORM_LOG:
-            lo, hi = np.log10(lo), np.log10(hi)
-        v = lo + unit * (hi - lo)
-        if self.transform == TRANSFORM_LOG:
-            v = 10.0 ** v
+        lo, hi = self._fwd(self.min), self._fwd(self.max)
+        v = self._bwd(lo + unit * (hi - lo))
         if self.discrete:
             v = float(np.round(v))
         return float(v)
 
     def scale_down(self, value: float) -> float:
         """native -> [0,1]."""
-        lo, hi = self.min, self.max
-        v = value
-        if self.transform == TRANSFORM_LOG:
-            lo, hi, v = np.log10(lo), np.log10(hi), np.log10(value)
+        lo, hi, v = self._fwd(self.min), self._fwd(self.max), self._fwd(value)
         return float(np.clip((v - lo) / (hi - lo) if hi > lo else 0.0, 0.0, 1.0))
 
 

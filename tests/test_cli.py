@@ -140,3 +140,59 @@ def test_feature_bags_driver(avro_paths, tmp_path):
     assert len(seen["features"]) == 5
     lines = open(os.path.join(out, "features")).read().strip().split("\n")
     assert len(lines) == 5 and "\t" in lines[0]
+
+
+def test_hyperparameter_tuning_bayesian_end_to_end(avro_paths, tmp_path):
+    """--hyper-parameter-tuning BAYESIAN: the grid results seed the tuner
+    (GameTrainingDriver.scala:666) and the tuned best beats a deliberately
+    over-regularized grid-only run (logistic loss: calibration-sensitive,
+    unlike AUC)."""
+    train_p, val_p = avro_paths
+    out_grid = str(tmp_path / "grid")
+    common = [
+        "--input-data", train_p,
+        "--validation-data", val_p,
+        "--task", "logistic_regression",
+        "--feature-shard", "name=globalShard,bags=features",
+        "--coordinate",
+        # absurdly strong L2 so the grid-only model is bad on purpose
+        "name=global,shard=globalShard,optimizer=LBFGS,tolerance=1e-7,"
+        "reg.type=L2,reg.weights=5000",
+        "--evaluators", "LOGISTIC_LOSS",
+    ]
+    grid = train.run(common + ["--output-dir", out_grid])
+    grid_loss = grid["best"]["metrics"]["LOGISTIC_LOSS"]
+
+    out_tuned = str(tmp_path / "tuned")
+    tuned = train.run(
+        common
+        + [
+            "--output-dir", out_tuned,
+            "--hyper-parameter-tuning", "BAYESIAN",
+            "--hyper-parameter-tuning-iter", "4",
+            "--output-mode", "TUNED",
+        ]
+    )
+    tuned_loss = tuned["best"]["metrics"]["LOGISTIC_LOSS"]
+    assert tuned_loss < grid_loss - 0.01
+    # grid + tuned observations are exported as a reusable prior file
+    prior_path = os.path.join(out_tuned, "hyperparameter-prior.json")
+    assert os.path.exists(prior_path)
+    with open(prior_path) as f:
+        prior = json.load(f)
+    assert len(prior["records"]) == 1 + 4  # 1 grid config + 4 tuned
+    assert all("global.reg_weight" in r for r in prior["records"])
+
+    # the prior file round-trips into a shrunk search range
+    out_shrunk = str(tmp_path / "shrunk")
+    shrunk = train.run(
+        common
+        + [
+            "--output-dir", out_shrunk,
+            "--hyper-parameter-tuning", "BAYESIAN",
+            "--hyper-parameter-tuning-iter", "2",
+            "--hyper-parameter-prior", prior_path,
+            "--output-mode", "TUNED",
+        ]
+    )
+    assert shrunk["best"]["metrics"]["LOGISTIC_LOSS"] < grid_loss - 0.01

@@ -131,3 +131,109 @@ def test_tuner_factory():
     assert len(obs) == 4
     with pytest.raises(ValueError):
         get_tuner("nope")
+
+
+# --- serialization / shrink (HyperparameterSerialization.scala:27-136,
+#     ShrinkSearchRange.scala:40-108) ---
+
+
+def test_config_from_json_reference_shape():
+    from photon_ml_tpu.tuning import config_from_json
+
+    mode, hp = config_from_json(
+        '{"tuning_mode": "BAYESIAN", "variables": {'
+        '"global.reg_weight": {"type": "DOUBLE", "min": 1e-4, "max": 1e4,'
+        ' "transform": "LOG"},'
+        '"alpha": {"type": "DOUBLE", "min": 0.0, "max": 1.0},'
+        '"k": {"type": "INT", "min": 0, "max": 8, "transform": "SQRT"}}}'
+    )
+    assert mode == "BAYESIAN"
+    names = [p.name for p in hp.params]
+    assert names == ["global.reg_weight", "alpha", "k"]
+    assert hp.params[0].transform == "LOG"
+    assert not hp.params[0].discrete
+    assert hp.params[2].discrete and hp.params[2].transform == "SQRT"
+    # INT -> discrete dims map (index -> cardinality)
+    assert hp.discrete_dims() == {2: 9}
+    # unknown mode -> NONE; missing variables -> error; bad transform -> error
+    mode2, _ = config_from_json('{"tuning_mode": "ATLAS", "variables": {}}')
+    assert mode2 == "NONE"
+    with pytest.raises(ValueError):
+        config_from_json('{"tuning_mode": "RANDOM"}')
+    with pytest.raises(ValueError):
+        config_from_json(
+            '{"variables": {"a": {"min": 0, "max": 1, "transform": "EXP"}}}'
+        )
+
+
+def test_sqrt_transform_round_trip():
+    from photon_ml_tpu.tuning.rescaling import ParamRange
+
+    p = ParamRange(name="x", min=1.0, max=100.0, transform="SQRT")
+    for unit in (0.0, 0.25, 0.5, 1.0):
+        native = p.scale_up(unit)
+        assert 1.0 <= native <= 100.0
+        np.testing.assert_allclose(p.scale_down(native), unit, atol=1e-12)
+    # sqrt-space midpoint: sqrt ranges 1..10, mid 5.5 -> 30.25
+    np.testing.assert_allclose(p.scale_up(0.5), 30.25)
+
+
+def test_prior_json_round_trip_with_defaults():
+    from photon_ml_tpu.tuning import prior_from_json, prior_to_json
+
+    names = ["a", "b"]
+    priors = [(np.array([0.1, 2.0]), 0.75), (np.array([10.0, 4.0]), 0.9)]
+    text = prior_to_json(names, priors)
+    back = prior_from_json(text, {}, names)
+    for (x0, v0), (x1, v1) in zip(priors, back):
+        np.testing.assert_allclose(x0, x1)
+        assert v0 == v1
+    # missing field falls back to default; no default -> KeyError
+    partial = '{"records": [{"a": "1.0", "evaluationValue": "0.5"}]}'
+    vecs = prior_from_json(partial, {"b": 7.0}, names)
+    np.testing.assert_allclose(vecs[0][0], [1.0, 7.0])
+    with pytest.raises(KeyError):
+        prior_from_json(partial, {}, names)
+
+
+def test_shrink_search_range_bounds():
+    from photon_ml_tpu.tuning import get_bounds
+
+    hp = HyperparameterConfig(
+        params=[ParamRange(name="lam", min=1e-4, max=1e4, transform="LOG")]
+    )
+    # evaluation peaks at lam = 1.0 (unit 0.5); GP should shrink around it
+    lams = np.array([1e-4, 1e-2, 1.0, 1e2, 1e4, 0.1, 10.0])
+    vals = [float(-(np.log10(l)) ** 2) for l in lams]
+    from photon_ml_tpu.tuning import prior_to_json
+
+    prior = prior_to_json(["lam"], [(np.array([l]), v) for l, v in zip(lams, vals)])
+    lo, hi = get_bounds(hp, prior, radius=0.2, seed=3)
+    assert lo.shape == (1,) and hi.shape == (1,)
+    # the shrunk range must be inside the original and contain the optimum
+    assert 1e-4 <= lo[0] < 1.0 < hi[0] <= 1e4
+    # radius 0.2 in unit space = 10^(8*0.4) ~ 1580x range vs original 1e8
+    assert hi[0] / lo[0] < 1e7
+
+
+def test_tuner_accepts_grid_observations():
+    """Seeding observations warm-starts the GP path (no cold-start random
+    draws once len(observations) > dim)."""
+    from photon_ml_tpu.tuning import BayesianTuner, Observation
+
+    calls = []
+
+    def ev(x):
+        calls.append(x.copy())
+        v = (x[0] - 0.3) ** 2
+        return float(v), None
+
+    seeds = [
+        Observation(candidate=np.array([u]), value=float((u - 0.3) ** 2))
+        for u in (0.05, 0.35, 0.65, 0.95)
+    ]
+    obs = BayesianTuner().search(6, 1, ev, observations=seeds, seed=7)
+    assert len(obs) == 6
+    # warm-started GP should concentrate near the seeded optimum
+    best = min(o.value for o in obs)
+    assert best < 0.01
