@@ -16,7 +16,7 @@ from typing import Dict, List, Optional, Set, Tuple
 
 from ..io.avro import iter_avro_directory
 from ..utils.logging import setup_logging
-from .params import add_common_io_args
+from .params import add_common_io_args, resolve_input_paths
 
 logger = logging.getLogger("photon_ml_tpu")
 
@@ -30,12 +30,17 @@ def build_parser() -> argparse.ArgumentParser:
     return p
 
 
+def _input_paths(args):
+    paths = resolve_input_paths(args)
+    return [paths] if isinstance(paths, str) else paths
+
+
 def run(argv: Optional[List[str]] = None):
     args = build_parser().parse_args(argv)
     setup_logging(args.log_level)
     bags = [b for b in args.feature_bags.split(",") if b]
     seen: Dict[str, Set[Tuple[str, str]]] = {b: set() for b in bags}
-    for rec in iter_avro_directory(args.input_data):
+    for rec in (r for path in _input_paths(args) for r in iter_avro_directory(path)):
         for bag in bags:
             for f in rec.get(bag) or ():
                 term = f.get("term")

@@ -30,7 +30,12 @@ from ..ops.regularization import RegularizationContext
 from ..optimize import OptimizerConfig, OptimizerType
 from ..utils.logging import setup_logging
 from ..utils.stats import compute_feature_statistics
-from .params import add_common_io_args, build_shard_configs
+from .params import (
+    add_common_io_args,
+    build_shard_configs,
+    parse_input_columns,
+    resolve_input_paths,
+)
 
 logger = logging.getLogger("photon_ml_tpu")
 
@@ -85,13 +90,16 @@ def run(argv: Optional[List[str]] = None):
         shards = build_shard_configs(args)
         shard = next(iter(shards))
         raw, index_maps = read_avro_dataset(
-            args.input_data, shards, response_column=args.response_column
+            resolve_input_paths(args), shards,
+            response_column=args.response_column,
+            columns=parse_input_columns(args),
         )
         validation = None
         if args.validation_data:
             validation, _ = read_avro_dataset(
                 args.validation_data, shards, index_maps=index_maps,
                 response_column=args.response_column,
+                columns=parse_input_columns(args),
             )
     validate_dataset(raw, args.task, args.validate_data)
     stats = compute_feature_statistics(raw, shard)

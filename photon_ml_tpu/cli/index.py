@@ -16,7 +16,7 @@ from ..io.avro import iter_avro_directory
 from ..io.data import build_index_maps
 from ..io.index_map import save_partitioned
 from ..utils.logging import setup_logging
-from .params import add_common_io_args, build_shard_configs
+from .params import add_common_io_args, build_shard_configs, resolve_input_paths
 
 logger = logging.getLogger("photon_ml_tpu")
 
@@ -30,11 +30,20 @@ def build_parser() -> argparse.ArgumentParser:
     return p
 
 
+def _input_paths(args):
+    paths = resolve_input_paths(args)
+    return [paths] if isinstance(paths, str) else paths
+
+
 def run(argv: Optional[List[str]] = None):
     args = build_parser().parse_args(argv)
     setup_logging(args.log_level)
     shards = build_shard_configs(args)
-    records = list(iter_avro_directory(args.input_data))
+    records = [
+        r
+        for path in _input_paths(args)
+        for r in iter_avro_directory(path)
+    ]
     index_maps = build_index_maps(records, shards)
     for shard, imap in index_maps.items():
         save_partitioned(imap, args.output_dir, args.num_partitions, shard)

@@ -109,10 +109,54 @@ def add_common_io_args(p: argparse.ArgumentParser):
     )
     p.add_argument("--response-column", default="label")
     p.add_argument(
+        "--input-column-names",
+        default="",
+        help="remap reserved columns: 'response=label,weight=importance,...' "
+        "(uid/response/offset/weight/metadataMap; InputColumnsNames.scala)",
+    )
+    p.add_argument(
+        "--input-data-date-range",
+        default=None,
+        help="yyyyMMdd-yyyyMMdd: read '<input-data>/yyyy/MM/dd' day dirs "
+        "within the range (DateRange.scala)",
+    )
+    p.add_argument(
+        "--input-data-days-ago",
+        default=None,
+        help="START-END days before today, START >= END (DaysRange.scala)",
+    )
+    p.add_argument(
         "--feature-index-dir",
         default=None,
         help="directory of prebuilt index stores (FeatureIndexingDriver output)",
     )
+
+
+def resolve_input_paths(args):
+    """--input-data plus optional date/days range -> list of day dirs (or the
+    base path unchanged); IOUtils.getInputPathsWithinDateRange semantics."""
+    from ..utils.dates import DateRange, DaysRange, input_paths_within_date_range
+
+    if args.input_data_date_range and args.input_data_days_ago:
+        raise SystemExit(
+            "--input-data-date-range and --input-data-days-ago are exclusive"
+        )
+    if args.input_data_date_range:
+        rng = DateRange.from_string(args.input_data_date_range)
+    elif args.input_data_days_ago:
+        rng = DaysRange.from_string(args.input_data_days_ago).to_date_range()
+    else:
+        return args.input_data
+    return input_paths_within_date_range(args.input_data, rng)
+
+
+def parse_input_columns(args):
+    """--input-column-names spec -> InputColumnsNames (default when empty)."""
+    from ..io.columns import InputColumnsNames
+
+    if not getattr(args, "input_column_names", ""):
+        return InputColumnsNames()
+    return InputColumnsNames.from_spec(args.input_column_names)
 
 
 def parse_mesh_shape(spec: Optional[str]):

@@ -23,7 +23,7 @@ from ..io.index_map import load_partitioned
 from ..io.model_io import load_game_model
 from ..io.schemas import SCORING_RESULT_AVRO
 from ..utils.logging import setup_logging
-from .params import add_common_io_args, build_shard_configs
+from .params import parse_input_columns, resolve_input_paths, add_common_io_args, build_shard_configs
 
 logger = logging.getLogger("photon_ml_tpu")
 
@@ -52,11 +52,12 @@ def run(argv: Optional[List[str]] = None):
     if args.feature_index_dir:
         index_maps = {s: load_partitioned(args.feature_index_dir, s) for s in shards}
     raw, index_maps = read_avro_dataset(
-        args.input_data,
+        resolve_input_paths(args),
         shards,
         index_maps=index_maps,
         id_tag_columns=id_tags,
         response_column=args.response_column,
+        columns=parse_input_columns(args),
     )
     model = load_game_model(args.model_input_dir, index_maps, task=args.task)
     # random-effect types must be available as id tags

@@ -40,7 +40,9 @@ from .params import (
     add_common_io_args,
     build_shard_configs,
     parse_coordinate,
+    parse_input_columns,
     parse_mesh_shape,
+    resolve_input_paths,
 )
 
 logger = logging.getLogger("photon_ml_tpu")
@@ -141,18 +143,21 @@ def run(argv: Optional[List[str]] = None) -> Dict:
         if cc.is_random_effect and cc.random_effect_type not in id_tags:
             id_tags.append(cc.random_effect_type)
 
-    logger.info("reading training data from %s", args.input_data)
+    input_paths = resolve_input_paths(args)
+    input_columns = parse_input_columns(args)
+    logger.info("reading training data from %s", input_paths)
     index_maps = None
     if args.feature_index_dir:
         from ..io.index_map import load_partitioned
 
         index_maps = {s: load_partitioned(args.feature_index_dir, s) for s in shards}
     raw, index_maps = read_avro_dataset(
-        args.input_data,
+        input_paths,
         shards,
         index_maps=index_maps,
         id_tag_columns=id_tags,
         response_column=args.response_column,
+        columns=input_columns,
     )
     logger.info("training rows: %d; shard dims: %s", raw.n_rows, raw.shard_dims)
 
@@ -164,6 +169,7 @@ def run(argv: Optional[List[str]] = None) -> Dict:
             index_maps=index_maps,
             id_tag_columns=id_tags,
             response_column=args.response_column,
+            columns=input_columns,
         )
 
     # normalization from feature statistics (GameTrainingDriver:555-571)
@@ -295,14 +301,19 @@ def _run_tuning(args, estimator, raw, validation, coords, prior_results):
         # the tuner minimizes; negate higher-is-better metrics
         return sign * metric, r
 
-    # seed the tuner with the explicit-grid results (convertObservations)
+    # seed the tuner with the explicit-grid results (convertObservations);
+    # skip grid points outside the search range — scale_down would clip them
+    # to the cube edge and attach a far-away point's metric to it
     observations = []
     for r in prior_results or []:
         if r.evaluation is None:
             continue
+        native = _native_vec(r, names)
+        if any(not (p.min <= v <= p.max) for p, v in zip(hp.params, native)):
+            continue
         observations.append(
             Observation(
-                candidate=hp.scale_down(_native_vec(r, names)),
+                candidate=hp.scale_down(native),
                 value=sign * r.evaluation.primary_metric,
                 artifact=r,
             )

@@ -1,4 +1,5 @@
 from .avro import iter_avro_directory, parse_schema, read_avro_file, write_avro_file
+from .columns import InputColumnsNames
 from .data import (
     FeatureShardConfig,
     RawDataset,
@@ -16,6 +17,7 @@ __all__ = [
     "iter_avro_directory",
     "parse_schema",
     "FeatureShardConfig",
+    "InputColumnsNames",
     "RawDataset",
     "read_avro_dataset",
     "read_libsvm",

@@ -13,9 +13,13 @@ fastavro package, so the codec is implemented from the Avro 1.x specification:
   (count, size, payload, sync) blocks; codecs ``null`` and ``deflate``
   (raw zlib, wbits=-15).
 
-Schema resolution between writer and reader schemas is not implemented;
-records decode with their writer schema (how the reference uses Avro too —
-generic records + field lookups, AvroUtils.scala).
+Reader-vs-writer schema resolution follows the Avro spec's resolution rules
+(pass ``reader_schema=`` to ``read_avro_file``/``iter_avro_directory``):
+record fields match by name, writer-only fields are skipped, reader-only
+fields take their defaults, numeric promotions (int->long/float/double,
+long->float/double, float->double) and string<->bytes conversions apply, and
+unions resolve branch-by-branch — so evolved production data decodes against
+the current schema.
 
 Decoding is the host-side IO hot path that feeds the TPU; the pure-Python
 loop is enough to saturate a single chip for the benchmark datasets, and the
@@ -100,6 +104,19 @@ def parse_schema(schema: Union[str, Schema]) -> Tuple[Schema, SchemaEnv]:
 # ---------------------------------------------------------------------------
 
 
+def _iter_block_counts(r: "_Reader") -> Iterator[int]:
+    """Yield per-block item counts of an Avro array/map encoding (negative
+    count means a byte size follows; 0 terminates)."""
+    while True:
+        count = r.read_long()
+        if count == 0:
+            return
+        if count < 0:
+            r.read_long()  # byte size, unused
+            count = -count
+        yield count
+
+
 class _Reader:
     __slots__ = ("buf", "pos")
 
@@ -179,27 +196,15 @@ def _read_datum(r: _Reader, schema: Schema, env: SchemaEnv) -> Any:
         return r.read(schema["size"])
     if t == "array":
         out: List[Any] = []
-        while True:
-            count = r.read_long()
-            if count == 0:
-                break
-            if count < 0:
-                r.read_long()  # byte size, unused
-                count = -count
-            items = schema["items"]
+        items = schema["items"]
+        for count in _iter_block_counts(r):
             for _ in range(count):
                 out.append(_read_datum(r, items, env))
         return out
     if t == "map":
         m: Dict[str, Any] = {}
-        while True:
-            count = r.read_long()
-            if count == 0:
-                break
-            if count < 0:
-                r.read_long()
-                count = -count
-            values = schema["values"]
+        values = schema["values"]
+        for count in _iter_block_counts(r):
             for _ in range(count):
                 key = r.read_string()  # key must decode before the value
                 m[key] = _read_datum(r, values, env)
@@ -208,6 +213,184 @@ def _read_datum(r: _Reader, schema: Schema, env: SchemaEnv) -> Any:
         idx = r.read_long()
         return _read_datum(r, schema["types"][idx], env)
     raise ValueError(f"Unsupported Avro type: {t!r}")
+
+
+# ---------------------------------------------------------------------------
+# reader-vs-writer schema resolution (Avro spec "Schema Resolution")
+# ---------------------------------------------------------------------------
+
+_PROMOTIONS = {
+    "int": {"int", "long", "float", "double"},
+    "long": {"long", "float", "double"},
+    "float": {"float", "double"},
+    "double": {"double"},
+    "string": {"string", "bytes"},
+    "bytes": {"bytes", "string"},
+}
+
+
+def _type_name(schema: Schema, env: SchemaEnv) -> str:
+    schema = env.resolve(schema)
+    if isinstance(schema, str):
+        return schema
+    if isinstance(schema, list):
+        return "union"
+    t = schema["type"]
+    if isinstance(t, (dict, list)):
+        return _type_name(t, env)
+    return t
+
+
+def _short_name(schema: dict) -> str:
+    return schema.get("name", "").split(".")[-1]
+
+
+def _match(writer: Schema, reader: Schema, wenv: SchemaEnv, renv: SchemaEnv) -> bool:
+    """Can data written with `writer` resolve into `reader`? (shallow check —
+    deep mismatches surface as errors during decode)."""
+    wt, rt = _type_name(writer, wenv), _type_name(reader, renv)
+    if wt in _PROMOTIONS:
+        return rt in _PROMOTIONS[wt]
+    if wt in ("null", "boolean"):
+        return rt == wt
+    if wt == "union" or rt == "union":
+        return True  # branch-level matching happens at decode time
+    if wt != rt:
+        return False
+    if wt in ("record", "error", "enum", "fixed"):
+        w, r = wenv.resolve(writer), renv.resolve(reader)
+        return _short_name(w) == _short_name(r)
+    return True  # array/map: item/value checked during decode
+
+
+def _read_resolved(
+    r: _Reader, writer: Schema, reader: Schema, wenv: SchemaEnv, renv: SchemaEnv
+) -> Any:
+    """Decode a datum written as `writer` into the shape of `reader`."""
+    writer = wenv.resolve(writer)
+    reader = renv.resolve(reader)
+
+    # unwrap {"type": <complex>} wrappers and the nonstandard
+    # {"type": "union", "types": [...]} union spelling
+    if isinstance(writer, dict):
+        if writer.get("type") == "union":
+            writer = writer["types"]
+        elif isinstance(writer.get("type"), (dict, list)):
+            return _read_resolved(r, writer["type"], reader, wenv, renv)
+    if isinstance(reader, dict):
+        if reader.get("type") == "union":
+            reader = reader["types"]
+        elif isinstance(reader.get("type"), (dict, list)):
+            return _read_resolved(r, writer, reader["type"], wenv, renv)
+
+    # writer union: read the branch index, resolve that branch against reader
+    if isinstance(writer, list):
+        idx = r.read_long()
+        return _read_resolved(r, writer[idx], reader, wenv, renv)
+    # reader union (writer is not): first matching reader branch
+    if isinstance(reader, list):
+        for branch in reader:
+            if _match(writer, branch, wenv, renv):
+                return _read_resolved(r, writer, branch, wenv, renv)
+        raise ValueError(
+            f"cannot resolve writer type {_type_name(writer, wenv)!r} "
+            f"into reader union {reader}"
+        )
+
+    wt = writer if isinstance(writer, str) else writer["type"]
+    rt = reader if isinstance(reader, str) else reader["type"]
+
+    if wt in _PRIMITIVES:
+        if rt not in _PROMOTIONS.get(wt, {wt}):
+            raise ValueError(f"cannot promote writer {wt!r} to reader {rt!r}")
+        value = _read_datum(r, wt, wenv)
+        if wt in ("int", "long") and rt in ("float", "double"):
+            return float(value)
+        if wt == "string" and rt == "bytes":
+            return value.encode("utf-8")
+        if wt == "bytes" and rt == "string":
+            return value.decode("utf-8")
+        return value
+
+    if wt != rt:
+        raise ValueError(f"writer type {wt!r} does not resolve to reader {rt!r}")
+
+    if wt in ("record", "error"):
+        if _short_name(writer) != _short_name(reader):
+            raise ValueError(
+                f"record name mismatch: writer {_short_name(writer)!r} "
+                f"vs reader {_short_name(reader)!r}"
+            )
+        reader_fields = {f["name"]: f for f in reader["fields"]}
+        out: Dict[str, Any] = {}
+        seen = set()
+        for wf in writer["fields"]:
+            name = wf["name"]
+            rf = reader_fields.get(name)
+            if rf is None:
+                _read_datum(r, wf["type"], wenv)  # skip writer-only field
+            else:
+                out[name] = _read_resolved(r, wf["type"], rf["type"], wenv, renv)
+                seen.add(name)
+        for name, rf in reader_fields.items():
+            if name not in seen:
+                if "default" not in rf:
+                    raise ValueError(
+                        f"reader field {name!r} missing from writer data and "
+                        "has no default"
+                    )
+                out[name] = _default_value(rf["type"], rf["default"], renv)
+        return out
+
+    if wt == "enum":
+        symbol = writer["symbols"][r.read_long()]
+        if symbol not in reader["symbols"]:
+            if "default" in reader:
+                return reader["default"]
+            raise ValueError(f"enum symbol {symbol!r} not in reader schema")
+        return symbol
+
+    if wt == "fixed":
+        if writer["size"] != reader["size"]:
+            raise ValueError("fixed size mismatch between writer and reader")
+        return r.read(writer["size"])
+
+    if wt == "array":
+        out_list: List[Any] = []
+        for count in _iter_block_counts(r):
+            for _ in range(count):
+                out_list.append(
+                    _read_resolved(r, writer["items"], reader["items"], wenv, renv)
+                )
+        return out_list
+
+    if wt == "map":
+        m: Dict[str, Any] = {}
+        for count in _iter_block_counts(r):
+            for _ in range(count):
+                key = r.read_string()
+                m[key] = _read_resolved(
+                    r, writer["values"], reader["values"], wenv, renv
+                )
+        return m
+
+    raise ValueError(f"Unsupported Avro type in resolution: {wt!r}")
+
+
+def _default_value(schema: Schema, default: Any, env: SchemaEnv) -> Any:
+    """Materialize a reader-schema field default (JSON shape -> datum). Per
+    the spec, a union field's default conforms to the union's FIRST branch."""
+    schema = env.resolve(schema)
+    if isinstance(schema, list):
+        return _default_value(schema[0], default, env)
+    t = schema if isinstance(schema, str) else schema["type"]
+    if t == "bytes" and isinstance(default, str):
+        return default.encode("iso-8859-1")
+    if t in ("int", "long") and default is not None:
+        return int(default)
+    if t in ("float", "double") and default is not None:
+        return float(default)
+    return default
 
 
 # ---------------------------------------------------------------------------
@@ -292,6 +475,9 @@ def _write_datum(w: _Writer, schema: Schema, datum: Any, env: SchemaEnv):
     if isinstance(t, (dict, list)):
         _write_datum(w, t, datum, env)
         return
+    if t == "union":  # nonstandard {"type": "union", "types": [...]} spelling
+        _write_datum(w, schema["types"], datum, env)
+        return
 
     if t == "null":
         return
@@ -343,8 +529,14 @@ def _write_datum(w: _Writer, schema: Schema, datum: Any, env: SchemaEnv):
 # ---------------------------------------------------------------------------
 
 
-def read_avro_file(path: str) -> Tuple[Schema, List[dict]]:
-    """Read one .avro Object Container File -> (writer schema, records)."""
+def read_avro_file(
+    path: str, reader_schema: Optional[Union[str, Schema]] = None
+) -> Tuple[Schema, List[dict]]:
+    """Read one .avro Object Container File -> (writer schema, records).
+
+    With ``reader_schema``, records are resolved into the reader's shape
+    (field defaults, numeric promotion, skipped writer-only fields); it may
+    be a schema or a pre-parsed ``(schema, SchemaEnv)`` pair."""
     with open(path, "rb") as f:
         data = f.read()
     r = _Reader(data)
@@ -358,6 +550,12 @@ def read_avro_file(path: str) -> Tuple[Schema, List[dict]]:
     schema, env = parse_schema(schema_json)
     sync = r.read(SYNC_SIZE)
 
+    if reader_schema is not None:
+        if isinstance(reader_schema, tuple):
+            rschema, renv = reader_schema
+        else:
+            rschema, renv = parse_schema(reader_schema)
+
     records: List[dict] = []
     while not r.at_end():
         count = r.read_long()
@@ -368,25 +566,33 @@ def read_avro_file(path: str) -> Tuple[Schema, List[dict]]:
         elif codec != "null":
             raise ValueError(f"Unsupported Avro codec: {codec}")
         br = _Reader(payload)
-        for _ in range(count):
-            records.append(_read_datum(br, schema, env))
+        if reader_schema is None:
+            for _ in range(count):
+                records.append(_read_datum(br, schema, env))
+        else:
+            for _ in range(count):
+                records.append(_read_resolved(br, schema, rschema, env, renv))
         block_sync = r.read(SYNC_SIZE)
         if block_sync != sync:
             raise ValueError(f"{path}: sync marker mismatch (corrupt file)")
     return schema, records
 
 
-def iter_avro_directory(path: str) -> Iterator[dict]:
+def iter_avro_directory(
+    path: str, reader_schema: Optional[Union[str, Schema]] = None
+) -> Iterator[dict]:
     """Read all part files of an Avro dataset directory (or a single file),
     mirroring how the reference consumes HDFS output dirs."""
+    if reader_schema is not None and not isinstance(reader_schema, tuple):
+        reader_schema = parse_schema(reader_schema)  # parse once for all parts
     if os.path.isfile(path):
-        yield from read_avro_file(path)[1]
+        yield from read_avro_file(path, reader_schema)[1]
         return
     names = sorted(os.listdir(path))
     for name in names:
         if name.startswith((".", "_")) or not name.endswith(".avro"):
             continue
-        yield from read_avro_file(os.path.join(path, name))[1]
+        yield from read_avro_file(os.path.join(path, name), reader_schema)[1]
 
 
 def write_avro_file(

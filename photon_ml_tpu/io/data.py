@@ -21,11 +21,19 @@ from __future__ import annotations
 
 import dataclasses
 import os
-from typing import Dict, Iterable, List, Mapping, Optional, Sequence, Tuple
+from typing import Dict, Iterable, List, Mapping, Optional, Sequence, Tuple, Union
 
 import numpy as np
 
 from .avro import iter_avro_directory
+from .columns import (
+    META_DATA_MAP,
+    OFFSET,
+    RESPONSE,
+    UID,
+    WEIGHT,
+    InputColumnsNames,
+)
 from .index_map import INTERCEPT_KEY, IndexMap, feature_key
 
 
@@ -152,10 +160,15 @@ def records_to_dataset(
     index_maps: Mapping[str, IndexMap],
     id_tag_columns: Sequence[str] = (),
     response_column: str = "label",
+    columns: Optional[InputColumnsNames] = None,
 ) -> RawDataset:
     """Decode Avro records into a RawDataset (AvroDataReader.readMerged
     semantics: bags merged per shard, name+term -> index, intercept injected,
-    unknown features dropped)."""
+    unknown features dropped). ``columns`` remaps the reserved uid/response/
+    offset/weight/metadataMap field names (InputColumnsNames.scala:29-106);
+    an explicit response remap takes precedence over response_column,
+    otherwise lookup order is response_column, 'response'."""
+    col_names = columns or InputColumnsNames()
     n = len(records)
     labels = np.zeros(n, dtype=np.float64)
     offsets = np.zeros(n, dtype=np.float64)
@@ -166,16 +179,26 @@ def records_to_dataset(
         s: ([], [], []) for s in shard_configs
     }
 
+    # an explicit response remap outranks the response_column default, so a
+    # stray field named 'label' can't shadow the remapped response
+    response_remapped = columns is not None and col_names[RESPONSE] != RESPONSE
     for i, rec in enumerate(records):
-        label = rec.get(response_column)
+        if response_remapped:
+            label = rec.get(col_names[RESPONSE])
+            if label is None:
+                label = rec.get(response_column)
+        else:
+            label = rec.get(response_column)
+            if label is None:
+                label = rec.get(col_names[RESPONSE])
         if label is None:
             label = rec.get("response")
         labels[i] = _num(label, 0.0)
-        offsets[i] = _num(rec.get("offset"), 0.0)
-        weights[i] = _num(rec.get("weight"), 1.0)
-        uid = rec.get("uid")
+        offsets[i] = _num(rec.get(col_names[OFFSET]), 0.0)
+        weights[i] = _num(rec.get(col_names[WEIGHT]), 1.0)
+        uid = rec.get(col_names[UID])
         uids.append(None if uid is None else str(uid))
-        meta = rec.get("metadataMap") or {}
+        meta = rec.get(col_names[META_DATA_MAP]) or {}
         for t in id_tag_columns:
             v = rec.get(t)
             if v is None:
@@ -229,19 +252,25 @@ def _merge_bags(rec: dict, bags: Tuple[str, ...]) -> Iterable[Tuple[str, float]]
 
 
 def read_avro_dataset(
-    path: str,
+    path: Union[str, Sequence[str]],
     shard_configs: Mapping[str, FeatureShardConfig],
     index_maps: Optional[Mapping[str, IndexMap]] = None,
     id_tag_columns: Sequence[str] = (),
     response_column: str = "label",
+    columns: Optional[InputColumnsNames] = None,
+    reader_schema=None,
 ) -> Tuple[RawDataset, Dict[str, IndexMap]]:
-    """Read an Avro file/directory into a RawDataset, building index maps from
-    the data when not supplied (DefaultIndexMapLoader path)."""
-    records = list(iter_avro_directory(path))
+    """Read Avro file(s)/directories into a RawDataset, building index maps
+    from the data when not supplied (DefaultIndexMapLoader path). ``path``
+    may be a list (e.g. date-ranged day directories); ``reader_schema``
+    resolves evolved writer data into the expected shape."""
+    paths = [path] if isinstance(path, str) else list(path)
+    records = [r for p in paths for r in iter_avro_directory(p, reader_schema)]
     if index_maps is None:
         index_maps = build_index_maps(records, shard_configs)
     ds = records_to_dataset(
-        records, shard_configs, index_maps, id_tag_columns, response_column
+        records, shard_configs, index_maps, id_tag_columns, response_column,
+        columns=columns,
     )
     return ds, dict(index_maps)
 

@@ -68,7 +68,10 @@ def config_from_json(text: str) -> Tuple[str, HyperparameterConfig]:
             transform = str(transform).upper()
             if transform not in _VALID_TRANSFORMS:
                 raise ValueError(f"invalid transform {transform!r} for {name!r}")
-        lo, hi = float(spec["min"]), float(spec["max"])
+        try:
+            lo, hi = float(spec["min"]), float(spec["max"])
+        except KeyError as e:
+            raise ValueError(f"variable {name!r} is missing required key {e}") from e
         if transform == TRANSFORM_LOG and lo <= 0:
             raise ValueError(f"LOG transform requires min > 0 for {name!r}, got {lo}")
         if transform == TRANSFORM_SQRT and lo < 0:

@@ -191,3 +191,221 @@ def test_random_effect_scoring_unseen_entity():
     fv = jnp.asarray([[1.0, 0.5], [1.0, 0.5]], jnp.float64)
     s = np.asarray(re.score_ell_rows(rows, fi, fv))
     np.testing.assert_allclose(s, [2.0 + 5.0, 0.0])
+
+
+# --- reader robustness: schema resolution, column remap, date ranges ---
+
+
+def test_avro_schema_resolution_evolved(tmp_path):
+    """Writer schema evolves: reader gains a defaulted field, loses a writer
+    field, promotes int->double (Avro spec resolution; io/avro.py)."""
+    from photon_ml_tpu.io import read_avro_file, write_avro_file
+
+    writer_schema = {
+        "type": "record",
+        "name": "Row",
+        "fields": [
+            {"name": "label", "type": "int"},
+            {"name": "legacy", "type": "string"},
+            {"name": "weight", "type": ["null", "float"], "default": None},
+        ],
+    }
+    recs = [
+        {"label": 1, "legacy": "drop-me", "weight": 2.5},
+        {"label": 0, "legacy": "x", "weight": None},
+    ]
+    p = str(tmp_path / "old.avro")
+    write_avro_file(p, writer_schema, recs)
+
+    reader_schema = {
+        "type": "record",
+        "name": "Row",
+        "fields": [
+            {"name": "label", "type": "double"},  # int -> double promotion
+            {"name": "weight", "type": ["null", "float"], "default": None},
+            {"name": "offset", "type": "double", "default": 0.25},  # new field
+        ],
+    }
+    _, out = read_avro_file(p, reader_schema=reader_schema)
+    assert out[0] == {"label": 1.0, "weight": 2.5, "offset": 0.25}
+    assert isinstance(out[0]["label"], float)
+    assert out[1] == {"label": 0.0, "weight": None, "offset": 0.25}
+    assert "legacy" not in out[0]  # writer-only field skipped
+
+    # without a reader schema the writer shape comes back unchanged
+    _, raw = read_avro_file(p)
+    assert raw[0]["legacy"] == "drop-me"
+
+    # a reader field with no default and no writer data is an error
+    bad_reader = {
+        "type": "record",
+        "name": "Row",
+        "fields": [{"name": "brand_new", "type": "double"}],
+    }
+    with pytest.raises(ValueError, match="no default"):
+        read_avro_file(p, reader_schema=bad_reader)
+
+
+def test_avro_schema_resolution_nested_and_union(tmp_path):
+    """Resolution recurses through arrays/records and across union shapes."""
+    from photon_ml_tpu.io import read_avro_file, write_avro_file
+
+    writer_schema = {
+        "type": "record",
+        "name": "Example",
+        "fields": [
+            {
+                "name": "features",
+                "type": {
+                    "type": "array",
+                    "items": {
+                        "type": "record",
+                        "name": "Feat",
+                        "fields": [
+                            {"name": "name", "type": "string"},
+                            {"name": "value", "type": "float"},
+                        ],
+                    },
+                },
+            },
+            {"name": "response", "type": "int"},
+        ],
+    }
+    recs = [{"features": [{"name": "a", "value": 1.5}], "response": 1}]
+    p = str(tmp_path / "nested.avro")
+    write_avro_file(p, writer_schema, recs)
+
+    reader_schema = {
+        "type": "record",
+        "name": "Example",
+        "fields": [
+            {
+                "name": "features",
+                "type": {
+                    "type": "array",
+                    "items": {
+                        "type": "record",
+                        "name": "Feat",
+                        "fields": [
+                            {"name": "name", "type": "string"},
+                            {"name": "value", "type": "double"},
+                            {"name": "term", "type": "string", "default": ""},
+                        ],
+                    },
+                },
+            },
+            # writer non-union int resolves into reader union double
+            {"name": "response", "type": ["null", "double"]},
+        ],
+    }
+    _, out = read_avro_file(p, reader_schema=reader_schema)
+    assert out[0]["features"] == [{"name": "a", "value": 1.5, "term": ""}]
+    assert out[0]["response"] == 1.0
+
+
+def test_input_columns_remap(tmp_path):
+    """Reserved columns read under user-remapped names
+    (InputColumnsNames.scala:29-106)."""
+    from photon_ml_tpu.io import (
+        FeatureShardConfig,
+        InputColumnsNames,
+        read_avro_dataset,
+        write_avro_file,
+    )
+
+    schema = {
+        "type": "record",
+        "name": "Custom",
+        "fields": [
+            {"name": "target", "type": "double"},
+            {"name": "importance", "type": "double"},
+            {"name": "baseline", "type": "double"},
+            {"name": "rowId", "type": "string"},
+            {"name": "tags", "type": {"type": "map", "values": "string"}},
+            {
+                "name": "features",
+                "type": {
+                    "type": "array",
+                    "items": {
+                        "type": "record",
+                        "name": "FeatureAvro",
+                        "fields": [
+                            {"name": "name", "type": "string"},
+                            {"name": "term", "type": "string"},
+                            {"name": "value", "type": "double"},
+                        ],
+                    },
+                },
+            },
+        ],
+    }
+    recs = [
+        {
+            "target": 1.0, "importance": 2.0, "baseline": 0.5, "rowId": "r0",
+            "tags": {"userId": "u7"},
+            "features": [{"name": "f", "term": "", "value": 3.0}],
+        }
+    ]
+    p = str(tmp_path / "custom.avro")
+    write_avro_file(p, schema, recs)
+
+    cols = InputColumnsNames.from_spec(
+        "response=target,weight=importance,offset=baseline,uid=rowId,metadataMap=tags"
+    )
+    ds, _ = read_avro_dataset(
+        p,
+        {"global": FeatureShardConfig(("features",))},
+        id_tag_columns=["userId"],
+        response_column="target",
+        columns=cols,
+    )
+    assert ds.labels[0] == 1.0
+    assert ds.weights[0] == 2.0
+    assert ds.offsets[0] == 0.5
+    assert ds.uids[0] == "r0"
+    assert ds.id_tags["userId"][0] == "u7"
+
+    # duplicate names rejected (InputColumnsNames uniqueness require)
+    with pytest.raises(ValueError, match="unique"):
+        InputColumnsNames.from_spec("response=weight")
+    with pytest.raises(ValueError, match="unknown input columns"):
+        InputColumnsNames.from_spec("bogus=x")
+
+
+def test_date_ranges_and_day_dirs(tmp_path):
+    import datetime
+
+    from photon_ml_tpu.utils.dates import (
+        DateRange,
+        DaysRange,
+        input_paths_within_date_range,
+    )
+
+    rng = DateRange.from_string("20260101-20260103")
+    assert [d.isoformat() for d in rng.days()] == [
+        "2026-01-01", "2026-01-02", "2026-01-03",
+    ]
+    assert str(rng) == "20260101-20260103"
+    with pytest.raises(ValueError):
+        DateRange.from_string("20260105-20260101")  # start after end
+    with pytest.raises(ValueError):
+        DateRange.from_string("2026-01-01")  # bad format
+
+    dr = DaysRange.from_string("3-1")
+    today = datetime.date(2026, 1, 4)
+    assert str(dr.to_date_range(today)) == "20260101-20260103"
+    with pytest.raises(ValueError):
+        DaysRange.from_string("1-3")  # start fewer days ago than end
+
+    # day-dir layout: only existing days come back, in order
+    base = tmp_path / "daily"
+    for day in ("2026/01/01", "2026/01/03"):
+        (base / day).mkdir(parents=True)
+    paths = input_paths_within_date_range(str(base), rng)
+    assert [p[-10:] for p in paths] == ["2026/01/01", "2026/01/03"]
+    with pytest.raises(FileNotFoundError):
+        input_paths_within_date_range(str(base), rng, error_on_missing=True)
+    with pytest.raises(FileNotFoundError):
+        input_paths_within_date_range(
+            str(base), DateRange.from_string("20300101-20300102")
+        )
