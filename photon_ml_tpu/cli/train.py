@@ -124,6 +124,13 @@ def build_parser() -> argparse.ArgumentParser:
         help="device mesh, e.g. data=4,model=2: data axis shards rows/entities, "
         "model axis shards the coefficient dim of layout=tiled coordinates",
     )
+    p.add_argument(
+        "--distributed",
+        default=None,
+        help="multi-host: 'coordinator=HOST:PORT,process=I,n=P' (or 'auto' "
+        "for env/cluster auto-detection); each process reads its own row "
+        "range and only process 0 writes outputs",
+    )
     p.add_argument("--log-file", default=None)
     p.add_argument("--log-level", default="INFO")
     return p
@@ -132,6 +139,19 @@ def build_parser() -> argparse.ArgumentParser:
 def run(argv: Optional[List[str]] = None) -> Dict:
     args = build_parser().parse_args(argv)
     setup_logging(args.log_level, args.log_file)
+
+    from ..parallel import multihost
+
+    if args.distributed:
+        if args.distributed == "auto":
+            multihost.initialize()
+        else:
+            multihost.initialize_from_spec(args.distributed)
+        logger.info(
+            "distributed: process %d/%d, %d local / %d global devices",
+            multihost.process_index(), multihost.process_count(),
+            __import__("jax").local_device_count(), __import__("jax").device_count(),
+        )
 
     shards = build_shard_configs(args)
     id_tags = [t for t in args.id_tags.split(",") if t]
@@ -151,6 +171,51 @@ def run(argv: Optional[List[str]] = None) -> Dict:
         from ..io.index_map import load_partitioned
 
         index_maps = {s: load_partitioned(args.feature_index_dir, s) for s in shards}
+
+    row_range = None
+    equal_share = None
+    if multihost.process_count() > 1:
+        if any(cc.is_random_effect for cc in coords):
+            raise SystemExit(
+                "multi-process training currently covers fixed-effect "
+                "coordinates (data-parallel gradients across hosts); "
+                "random-effect entity planning is single-process"
+            )
+        if any(getattr(cc, "layout", None) == "tiled" for cc in coords):
+            raise SystemExit(
+                "layout=tiled (model-axis sharding) is single-process only; "
+                "multi-process runs shard the data axis"
+            )
+        if index_maps is None:
+            raise SystemExit(
+                "multi-process training requires --feature-index-dir "
+                "(host-local index maps would disagree across hosts)"
+            )
+        if args.normalization != "NONE":
+            raise SystemExit(
+                "multi-process training does not support --normalization yet "
+                "(statistics would be computed from host-local rows only)"
+            )
+        if args.compute_feature_stats:
+            raise SystemExit(
+                "--compute-feature-stats is single-process only (it would "
+                "summarize the coordinator's row slice as if it were global)"
+            )
+        from ..io.avro import count_avro_rows, list_avro_parts
+
+        paths = [input_paths] if isinstance(input_paths, str) else input_paths
+        total_rows = sum(
+            count_avro_rows(part) for p in paths for part in list_avro_parts(p)
+        )
+        row_range = multihost.host_row_range(total_rows)
+        # all hosts pad their slice to a common size so every process
+        # contributes equal local shapes to the global arrays
+        equal_share = multihost.equal_host_share(total_rows)
+        logger.info(
+            "process %d reads rows [%d, %d) of %d (padded to %d)",
+            multihost.process_index(), row_range[0], row_range[1], total_rows,
+            equal_share,
+        )
     raw, index_maps = read_avro_dataset(
         input_paths,
         shards,
@@ -158,7 +223,10 @@ def run(argv: Optional[List[str]] = None) -> Dict:
         id_tag_columns=id_tags,
         response_column=args.response_column,
         columns=input_columns,
+        row_range=row_range,
     )
+    if equal_share is not None:
+        raw = raw.pad_rows(equal_share)
     logger.info("training rows: %d; shard dims: %s", raw.n_rows, raw.shard_dims)
 
     validation = None
@@ -185,7 +253,7 @@ def run(argv: Optional[List[str]] = None) -> Dict:
                     intercept_index=index_maps[cc.feature_shard].intercept_index,
                 )
 
-    if args.compute_feature_stats:
+    if args.compute_feature_stats and multihost.is_coordinator():
         os.makedirs(args.output_dir, exist_ok=True)
         for shard in shards:
             save_feature_statistics(
@@ -225,7 +293,6 @@ def run(argv: Optional[List[str]] = None) -> Dict:
     all_results = list(results) + tuned_results
     best = estimator.select_best(all_results)
 
-    os.makedirs(args.output_dir, exist_ok=True)
     summary = {
         "task": args.task,
         "configs": [
@@ -240,6 +307,11 @@ def run(argv: Optional[List[str]] = None) -> Dict:
             "metrics": None if best.evaluation is None else best.evaluation.metrics,
         },
     }
+    if not multihost.is_coordinator():
+        # only process 0 writes outputs (the reference's driver-to-HDFS role)
+        return summary
+
+    os.makedirs(args.output_dir, exist_ok=True)
     with open(os.path.join(args.output_dir, "training-summary.json"), "w") as f:
         json.dump(summary, f, indent=2, default=float)
 
@@ -335,9 +407,12 @@ def _run_tuning(args, estimator, raw, validation, coords, prior_results):
         for r in list(prior_results or []) + results
         if r.evaluation is not None
     ]
-    os.makedirs(args.output_dir, exist_ok=True)
-    with open(os.path.join(args.output_dir, "hyperparameter-prior.json"), "w") as f:
-        f.write(prior_to_json(names, priors))
+    from ..parallel import multihost
+
+    if multihost.is_coordinator():
+        os.makedirs(args.output_dir, exist_ok=True)
+        with open(os.path.join(args.output_dir, "hyperparameter-prior.json"), "w") as f:
+            f.write(prior_to_json(names, priors))
     return results
 
 

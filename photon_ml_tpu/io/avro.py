@@ -31,6 +31,7 @@ from __future__ import annotations
 
 import io as _io
 import json
+import mmap
 import os
 import struct
 import zlib
@@ -530,52 +531,112 @@ def _write_datum(w: _Writer, schema: Schema, datum: Any, env: SchemaEnv):
 
 
 def read_avro_file(
-    path: str, reader_schema: Optional[Union[str, Schema]] = None
+    path: str,
+    reader_schema: Optional[Union[str, Schema]] = None,
+    row_range: Optional[Tuple[int, int]] = None,
 ) -> Tuple[Schema, List[dict]]:
     """Read one .avro Object Container File -> (writer schema, records).
 
     With ``reader_schema``, records are resolved into the reader's shape
     (field defaults, numeric promotion, skipped writer-only fields); it may
-    be a schema or a pre-parsed ``(schema, SchemaEnv)`` pair."""
+    be a schema or a pre-parsed ``(schema, SchemaEnv)`` pair.
+
+    With ``row_range=(start, stop)``, only records in that index window come
+    back; blocks wholly outside the window are skipped WITHOUT decompressing
+    or decoding (the per-host input split of the multi-process runtime —
+    each host pays IO+decode for ~1/P of the data). The file is memory-mapped,
+    so skipped payload pages are never read from disk."""
     with open(path, "rb") as f:
-        data = f.read()
-    r = _Reader(data)
-    if r.read(4) != MAGIC:
-        raise ValueError(f"{path}: not an Avro object container file")
-    meta_schema = {"type": "map", "values": "bytes"}
-    env0 = SchemaEnv()
-    meta = _read_datum(r, meta_schema, env0)
-    schema_json = meta["avro.schema"].decode("utf-8")
-    codec = meta.get("avro.codec", b"null").decode("utf-8")
-    schema, env = parse_schema(schema_json)
-    sync = r.read(SYNC_SIZE)
+        try:
+            data = mmap.mmap(f.fileno(), 0, access=mmap.ACCESS_READ)
+        except ValueError:  # zero-length file
+            raise ValueError(f"{path}: not an Avro object container file")
+        with data:
+            r = _Reader(data)
+            if r.read(4) != MAGIC:
+                raise ValueError(f"{path}: not an Avro object container file")
+            meta_schema = {"type": "map", "values": "bytes"}
+            env0 = SchemaEnv()
+            meta = _read_datum(r, meta_schema, env0)
+            schema_json = meta["avro.schema"].decode("utf-8")
+            codec = meta.get("avro.codec", b"null").decode("utf-8")
+            schema, env = parse_schema(schema_json)
+            sync = r.read(SYNC_SIZE)
 
-    if reader_schema is not None:
-        if isinstance(reader_schema, tuple):
-            rschema, renv = reader_schema
-        else:
-            rschema, renv = parse_schema(reader_schema)
+            if reader_schema is not None:
+                if isinstance(reader_schema, tuple):
+                    rschema, renv = reader_schema
+                else:
+                    rschema, renv = parse_schema(reader_schema)
 
-    records: List[dict] = []
-    while not r.at_end():
-        count = r.read_long()
-        size = r.read_long()
-        payload = r.read(size)
-        if codec == "deflate":
-            payload = zlib.decompress(payload, -15)
-        elif codec != "null":
-            raise ValueError(f"Unsupported Avro codec: {codec}")
-        br = _Reader(payload)
-        if reader_schema is None:
-            for _ in range(count):
-                records.append(_read_datum(br, schema, env))
-        else:
-            for _ in range(count):
-                records.append(_read_resolved(br, schema, rschema, env, renv))
-        block_sync = r.read(SYNC_SIZE)
-        if block_sync != sync:
-            raise ValueError(f"{path}: sync marker mismatch (corrupt file)")
-    return schema, records
+            records: List[dict] = []
+            row_idx = 0
+            while not r.at_end():
+                count = r.read_long()
+                size = r.read_long()
+                if row_range is not None and row_idx >= row_range[1]:
+                    break  # past the window: nothing left to decode
+                if row_range is not None and row_idx + count <= row_range[0]:
+                    r.pos += size + SYNC_SIZE  # skip payload + sync unread
+                    row_idx += count
+                    continue
+                payload = r.read(size)
+                if codec == "deflate":
+                    payload = zlib.decompress(payload, -15)
+                elif codec != "null":
+                    raise ValueError(f"Unsupported Avro codec: {codec}")
+                br = _Reader(payload)
+                block: List[dict] = []
+                if reader_schema is None:
+                    for _ in range(count):
+                        block.append(_read_datum(br, schema, env))
+                else:
+                    for _ in range(count):
+                        block.append(_read_resolved(br, schema, rschema, env, renv))
+                if row_range is not None:
+                    lo = max(row_range[0] - row_idx, 0)
+                    hi = min(row_range[1] - row_idx, count)
+                    block = block[lo:hi]
+                records.extend(block)
+                row_idx += count
+                block_sync = r.read(SYNC_SIZE)
+                if block_sync != sync:
+                    raise ValueError(f"{path}: sync marker mismatch (corrupt file)")
+            return schema, records
+
+
+def count_avro_rows(path: str) -> int:
+    """Record count of an Object Container File from block headers alone —
+    no decompression, no record decode."""
+    with open(path, "rb") as f:
+        try:
+            data = mmap.mmap(f.fileno(), 0, access=mmap.ACCESS_READ)
+        except ValueError:
+            raise ValueError(f"{path}: not an Avro object container file")
+        with data:
+            r = _Reader(data)
+            if r.read(4) != MAGIC:
+                raise ValueError(f"{path}: not an Avro object container file")
+            _read_datum(r, {"type": "map", "values": "bytes"}, SchemaEnv())
+            r.pos += SYNC_SIZE
+            total = 0
+            while not r.at_end():
+                count = r.read_long()
+                size = r.read_long()
+                r.pos += size + SYNC_SIZE
+                total += count
+            return total
+
+
+def list_avro_parts(path: str) -> List[str]:
+    """Part files of an Avro dataset directory (or the single file itself)."""
+    if os.path.isfile(path):
+        return [path]
+    return [
+        os.path.join(path, name)
+        for name in sorted(os.listdir(path))
+        if not name.startswith((".", "_")) and name.endswith(".avro")
+    ]
 
 
 def iter_avro_directory(
@@ -585,14 +646,8 @@ def iter_avro_directory(
     mirroring how the reference consumes HDFS output dirs."""
     if reader_schema is not None and not isinstance(reader_schema, tuple):
         reader_schema = parse_schema(reader_schema)  # parse once for all parts
-    if os.path.isfile(path):
-        yield from read_avro_file(path, reader_schema)[1]
-        return
-    names = sorted(os.listdir(path))
-    for name in names:
-        if name.startswith((".", "_")) or not name.endswith(".avro"):
-            continue
-        yield from read_avro_file(os.path.join(path, name), reader_schema)[1]
+    for part in list_avro_parts(path):
+        yield from read_avro_file(part, reader_schema)[1]
 
 
 def write_avro_file(

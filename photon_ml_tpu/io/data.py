@@ -25,7 +25,7 @@ from typing import Dict, Iterable, List, Mapping, Optional, Sequence, Tuple, Uni
 
 import numpy as np
 
-from .avro import iter_avro_directory
+from .avro import iter_avro_directory, read_avro_file
 from .columns import (
     META_DATA_MAP,
     OFFSET,
@@ -77,6 +77,29 @@ class RawDataset:
             shard_dims=dict(self.shard_dims),
             id_tags={t: v[rows] for t, v in self.id_tags.items()},
             uids=None if self.uids is None else self.uids[rows],
+        )
+
+    def pad_rows(self, target: int) -> "RawDataset":
+        """Zero-weight-pad to `target` rows (empty features, label/offset 0):
+        equalizes per-host shares in multi-process mode so every process
+        contributes the same local shape to the global arrays."""
+        if target <= self.n_rows:
+            return self
+        extra = target - self.n_rows
+        return RawDataset(
+            n_rows=target,
+            labels=np.concatenate([self.labels, np.zeros(extra)]),
+            offsets=np.concatenate([self.offsets, np.zeros(extra)]),
+            weights=np.concatenate([self.weights, np.zeros(extra)]),
+            shard_coo=dict(self.shard_coo),
+            shard_dims=dict(self.shard_dims),
+            id_tags={
+                t: np.concatenate([v, np.full(extra, "", dtype=object)])
+                for t, v in self.id_tags.items()
+            },
+            uids=None
+            if self.uids is None
+            else np.concatenate([self.uids, np.full(extra, None, dtype=object)]),
         )
 
     def to_batch(self, shard: str, dtype=None, layout: str = "auto", mesh=None):
@@ -259,13 +282,44 @@ def read_avro_dataset(
     response_column: str = "label",
     columns: Optional[InputColumnsNames] = None,
     reader_schema=None,
+    row_range: Optional[Tuple[int, int]] = None,
 ) -> Tuple[RawDataset, Dict[str, IndexMap]]:
     """Read Avro file(s)/directories into a RawDataset, building index maps
     from the data when not supplied (DefaultIndexMapLoader path). ``path``
     may be a list (e.g. date-ranged day directories); ``reader_schema``
-    resolves evolved writer data into the expected shape."""
+    resolves evolved writer data into the expected shape.
+
+    ``row_range=(start, stop)`` reads only that global row window across the
+    concatenated part files (per-host input split for the multi-process
+    runtime; blocks outside the window are skipped without decode). Index
+    maps must be prebuilt in that mode — a host-local map would disagree
+    across hosts."""
     paths = [path] if isinstance(path, str) else list(path)
-    records = [r for p in paths for r in iter_avro_directory(p, reader_schema)]
+    if row_range is None:
+        records = [r for p in paths for r in iter_avro_directory(p, reader_schema)]
+    else:
+        if index_maps is None:
+            raise ValueError(
+                "row_range reading requires prebuilt index_maps (a host-local "
+                "index map would be inconsistent across hosts); run the "
+                "feature-indexing driver first"
+            )
+        from .avro import count_avro_rows, list_avro_parts, parse_schema
+
+        if reader_schema is not None and not isinstance(reader_schema, tuple):
+            reader_schema = parse_schema(reader_schema)
+        start, stop = row_range
+        records = []
+        offset = 0
+        for p in paths:
+            for part in list_avro_parts(p):
+                n = count_avro_rows(part)
+                lo, hi = max(start - offset, 0), min(stop - offset, n)
+                if lo < hi:
+                    records.extend(
+                        read_avro_file(part, reader_schema, row_range=(lo, hi))[1]
+                    )
+                offset += n
     if index_maps is None:
         index_maps = build_index_maps(records, shard_configs)
     ds = records_to_dataset(

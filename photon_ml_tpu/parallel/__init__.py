@@ -1,3 +1,4 @@
+from . import multihost
 from .mesh import (
     DATA_AXIS,
     MODEL_AXIS,
@@ -20,4 +21,5 @@ __all__ = [
     "shard_coefficients",
     "shard_entity_blocks",
     "replicate",
+    "multihost",
 ]

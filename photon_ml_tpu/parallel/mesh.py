@@ -15,8 +15,15 @@ with JAX sharding primitives:
   reduce-scatter (the analogue of scaling "hundreds of billions of
   coefficients", README.md:56).
 
-Multi-host: `jax.distributed.initialize()` + the same code — collectives ride
-ICI within a slice and DCN across slices; nothing here is host-count-aware.
+Multi-host: `jax.distributed.initialize()` (parallel/multihost.py) + the same
+code — collectives ride ICI within a slice and DCN across slices. Placement
+helpers route through ``multihost.put_global``: single-process they are plain
+``device_put``; multi-process each process contributes its local block (its
+per-host row range / entity range) and the result is one globally-sharded
+``jax.Array``. In multi-process mode every process must contribute equal
+local shapes (pad per-host shares to ``multihost.equal_host_share``), and
+only DATA-axis sharding is supported — model-axis sharding across processes
+would need per-host coefficient slices and is rejected explicitly.
 """
 
 from __future__ import annotations
@@ -30,6 +37,7 @@ import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from ..ops.features import FeatureMatrix, LabeledBatch, pad_batch
+from .multihost import put_global
 
 DATA_AXIS = "data"
 MODEL_AXIS = "model"
@@ -52,8 +60,12 @@ def data_parallel_mesh(n: Optional[int] = None, devices=None) -> Mesh:
 
 
 def pad_rows_for_mesh(batch: LabeledBatch, mesh: Mesh) -> LabeledBatch:
-    """Zero-weight-pad the batch so the row count divides the data axis."""
+    """Zero-weight-pad the batch so the row count divides the data axis
+    (multi-process: the LOCAL row count must divide the local share of the
+    data axis)."""
     n_data = mesh.shape[DATA_AXIS]
+    if jax.process_count() > 1:
+        n_data = max(n_data // jax.process_count(), 1)
     n = batch.n_rows
     target = ((n + n_data - 1) // n_data) * n_data
     return pad_batch(batch, target)
@@ -73,19 +85,19 @@ def shard_batch(
         )
     batch = pad_rows_for_mesh(batch, mesh)
     row_spec = P(DATA_AXIS)
-    put1 = lambda a: jax.device_put(a, NamedSharding(mesh, row_spec))
+    put1 = lambda a: put_global(a, mesh, row_spec)
     f = batch.features
     if f.is_dense:
+        if shard_features_dim:
+            _reject_multiprocess_model_axis()
         spec = P(DATA_AXIS, MODEL_AXIS if shard_features_dim else None)
-        feats = FeatureMatrix(
-            dim=f.dim, dense=jax.device_put(f.dense, NamedSharding(mesh, spec))
-        )
+        feats = FeatureMatrix(dim=f.dim, dense=put_global(f.dense, mesh, spec))
     else:
         spec = P(DATA_AXIS, None)
         feats = FeatureMatrix(
             dim=f.dim,
-            idx=jax.device_put(f.idx, NamedSharding(mesh, spec)),
-            val=jax.device_put(f.val, NamedSharding(mesh, spec)),
+            idx=put_global(f.idx, mesh, spec),
+            val=put_global(f.val, mesh, spec),
         )
     return LabeledBatch(
         features=feats,
@@ -96,14 +108,25 @@ def shard_batch(
 
 
 def replicate(tree, mesh: Mesh):
-    """Replicated placement (the reference's coefficient broadcast, P4)."""
-    sharding = NamedSharding(mesh, P())
-    return jax.tree_util.tree_map(lambda a: jax.device_put(a, sharding), tree)
+    """Replicated placement (the reference's coefficient broadcast, P4).
+    Multi-process: every process must hold the full (identical) array."""
+    return jax.tree_util.tree_map(lambda a: put_global(a, mesh, P()), tree)
+
+
+def _reject_multiprocess_model_axis():
+    if jax.process_count() > 1:
+        raise NotImplementedError(
+            "model-axis sharding across processes is not supported yet: "
+            "callers pass full arrays, but each process may only contribute "
+            "its own model-axis slice; multi-process runs shard the data "
+            "axis only"
+        )
 
 
 def shard_coefficients(w: jnp.ndarray, mesh: Mesh) -> jnp.ndarray:
     """Shard a coefficient vector over the model axis (huge-d regime)."""
-    return jax.device_put(w, NamedSharding(mesh, P(MODEL_AXIS)))
+    _reject_multiprocess_model_axis()
+    return put_global(w, mesh, P(MODEL_AXIS))
 
 
 def shard_entity_blocks(blocks, mesh: Mesh):
@@ -118,6 +141,6 @@ def shard_entity_blocks(blocks, mesh: Mesh):
 
     def put(a):
         spec = P(*([DATA_AXIS] + [None] * (a.ndim - 1)))
-        return jax.device_put(a, NamedSharding(mesh, spec))
+        return put_global(a, mesh, spec)
 
     return jax.tree_util.tree_map(put, blocks)
