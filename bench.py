@@ -14,7 +14,7 @@ solver settings, with the per-entity loop time extrapolated from a subsample.
 value = examples/sec/chip for one CD sweep = n_rows / sweep_wall_clock.
 
 Extra configs — measured values for ALL configs are recorded in BASELINE.md
-("Measured" section) with the exact commands:
+("Measured" section, with the exact commands and the round they were taken):
   python bench.py --config sparse    # d=10M sorted-COO fixed effect vs scipy
   python bench.py --config billion   # 1B-coefficient streaming RE sweep
   python bench.py --config tiled     # per-tile cost division under 8-way tiling

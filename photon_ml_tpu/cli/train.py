@@ -147,10 +147,18 @@ def run(argv: Optional[List[str]] = None) -> Dict:
             multihost.initialize()
         else:
             multihost.initialize_from_spec(args.distributed)
+        import jax  # only safe to touch after jax.distributed.initialize
+
+        if not args.mesh_shape:
+            raise SystemExit(
+                "--distributed requires --mesh-shape spanning all global "
+                f"devices (e.g. data={jax.device_count()}); without a mesh "
+                "each process would silently train on only its own row slice"
+            )
         logger.info(
             "distributed: process %d/%d, %d local / %d global devices",
             multihost.process_index(), multihost.process_count(),
-            __import__("jax").local_device_count(), __import__("jax").device_count(),
+            jax.local_device_count(), jax.device_count(),
         )
 
     shards = build_shard_configs(args)
@@ -174,6 +182,7 @@ def run(argv: Optional[List[str]] = None) -> Dict:
 
     row_range = None
     equal_share = None
+    part_counts = None
     if multihost.process_count() > 1:
         if any(cc.is_random_effect for cc in coords):
             raise SystemExit(
@@ -204,9 +213,12 @@ def run(argv: Optional[List[str]] = None) -> Dict:
         from ..io.avro import count_avro_rows, list_avro_parts
 
         paths = [input_paths] if isinstance(input_paths, str) else input_paths
-        total_rows = sum(
-            count_avro_rows(part) for p in paths for part in list_avro_parts(p)
-        )
+        part_counts = {
+            part: count_avro_rows(part)
+            for p in paths
+            for part in list_avro_parts(p)
+        }
+        total_rows = sum(part_counts.values())
         row_range = multihost.host_row_range(total_rows)
         # all hosts pad their slice to a common size so every process
         # contributes equal local shapes to the global arrays
@@ -224,6 +236,7 @@ def run(argv: Optional[List[str]] = None) -> Dict:
         response_column=args.response_column,
         columns=input_columns,
         row_range=row_range,
+        part_counts=part_counts,
     )
     if equal_share is not None:
         raw = raw.pad_rows(equal_share)

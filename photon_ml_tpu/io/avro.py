@@ -577,7 +577,13 @@ def read_avro_file(
                 if row_range is not None and row_idx >= row_range[1]:
                     break  # past the window: nothing left to decode
                 if row_range is not None and row_idx + count <= row_range[0]:
-                    r.pos += size + SYNC_SIZE  # skip payload + sync unread
+                    r.pos += size  # skip payload pages entirely
+                    if r.pos + SYNC_SIZE > len(r.buf):
+                        raise ValueError(f"{path}: truncated block (corrupt file)")
+                    if r.read(SYNC_SIZE) != sync:
+                        raise ValueError(
+                            f"{path}: sync marker mismatch (corrupt file)"
+                        )
                     row_idx += count
                     continue
                 payload = r.read(size)

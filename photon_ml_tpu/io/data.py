@@ -283,6 +283,7 @@ def read_avro_dataset(
     columns: Optional[InputColumnsNames] = None,
     reader_schema=None,
     row_range: Optional[Tuple[int, int]] = None,
+    part_counts: Optional[Mapping[str, int]] = None,
 ) -> Tuple[RawDataset, Dict[str, IndexMap]]:
     """Read Avro file(s)/directories into a RawDataset, building index maps
     from the data when not supplied (DefaultIndexMapLoader path). ``path``
@@ -293,7 +294,8 @@ def read_avro_dataset(
     concatenated part files (per-host input split for the multi-process
     runtime; blocks outside the window are skipped without decode). Index
     maps must be prebuilt in that mode — a host-local map would disagree
-    across hosts."""
+    across hosts. ``part_counts`` (part path -> row count) skips the
+    per-part header scan when the caller already counted."""
     paths = [path] if isinstance(path, str) else list(path)
     if row_range is None:
         records = [r for p in paths for r in iter_avro_directory(p, reader_schema)]
@@ -313,7 +315,10 @@ def read_avro_dataset(
         offset = 0
         for p in paths:
             for part in list_avro_parts(p):
-                n = count_avro_rows(part)
+                if part_counts is not None and part in part_counts:
+                    n = part_counts[part]
+                else:
+                    n = count_avro_rows(part)
                 lo, hi = max(start - offset, 0), min(stop - offset, n)
                 if lo < hi:
                     records.extend(

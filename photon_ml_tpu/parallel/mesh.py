@@ -93,6 +93,13 @@ def shard_batch(
         spec = P(DATA_AXIS, MODEL_AXIS if shard_features_dim else None)
         feats = FeatureMatrix(dim=f.dim, dense=put_global(f.dense, mesh, spec))
     else:
+        if jax.process_count() > 1:
+            raise NotImplementedError(
+                "multi-process ELL sharding is not supported: the ELL width "
+                "is the max nnz of the LOCAL rows, so per-host shapes (and "
+                "the compiled programs) would disagree; use a dense layout "
+                "(d <= 4096) for multi-process runs"
+            )
         spec = P(DATA_AXIS, None)
         feats = FeatureMatrix(
             dim=f.dim,
