@@ -284,6 +284,7 @@ def read_avro_dataset(
     reader_schema=None,
     row_range: Optional[Tuple[int, int]] = None,
     part_counts: Optional[Mapping[str, int]] = None,
+    engine: str = "auto",
 ) -> Tuple[RawDataset, Dict[str, IndexMap]]:
     """Read Avro file(s)/directories into a RawDataset, building index maps
     from the data when not supplied (DefaultIndexMapLoader path). ``path``
@@ -295,36 +296,57 @@ def read_avro_dataset(
     runtime; blocks outside the window are skipped without decode). Index
     maps must be prebuilt in that mode — a host-local map would disagree
     across hosts. ``part_counts`` (part path -> row count) skips the
-    per-part header scan when the caller already counted."""
+    per-part header scan when the caller already counted.
+
+    ``engine``: 'auto' uses the native C++ columnar decoder
+    (photon_ml_tpu/native) when it is available and the request fits it
+    (no reader_schema), falling back to the pure-Python codec; 'native'
+    requires it; 'python' forces the fallback."""
     paths = [path] if isinstance(path, str) else list(path)
+    if engine not in ("auto", "native", "python"):
+        raise ValueError(f"unknown engine {engine!r}")
+    if engine == "native" and reader_schema is not None:
+        raise ValueError(
+            "engine='native' does not support reader_schema resolution"
+        )
+    if row_range is not None and index_maps is None:
+        raise ValueError(
+            "row_range reading requires prebuilt index_maps (a host-local "
+            "index map would be inconsistent across hosts); run the "
+            "feature-indexing driver first"
+        )
+    if engine != "python" and reader_schema is None:
+        out = None
+        try:
+            out = _native_read(
+                paths, shard_configs, index_maps, id_tag_columns,
+                response_column, columns, row_range, part_counts,
+            )
+        except Exception:
+            if engine == "native":
+                raise
+            import logging
+
+            logging.getLogger("photon_ml_tpu").warning(
+                "native Avro decode failed; falling back to Python codec",
+                exc_info=True,
+            )
+        if out is not None:
+            return out
+        if engine == "native":
+            raise RuntimeError("native decoder unavailable (no g++/zlib?)")
     if row_range is None:
         records = [r for p in paths for r in iter_avro_directory(p, reader_schema)]
     else:
-        if index_maps is None:
-            raise ValueError(
-                "row_range reading requires prebuilt index_maps (a host-local "
-                "index map would be inconsistent across hosts); run the "
-                "feature-indexing driver first"
-            )
-        from .avro import count_avro_rows, list_avro_parts, parse_schema
+        from .avro import parse_schema
 
         if reader_schema is not None and not isinstance(reader_schema, tuple):
             reader_schema = parse_schema(reader_schema)
-        start, stop = row_range
         records = []
-        offset = 0
-        for p in paths:
-            for part in list_avro_parts(p):
-                if part_counts is not None and part in part_counts:
-                    n = part_counts[part]
-                else:
-                    n = count_avro_rows(part)
-                lo, hi = max(start - offset, 0), min(stop - offset, n)
-                if lo < hi:
-                    records.extend(
-                        read_avro_file(part, reader_schema, row_range=(lo, hi))[1]
-                    )
-                offset += n
+        for part, window in _iter_part_windows(paths, row_range, part_counts):
+            records.extend(
+                read_avro_file(part, reader_schema, row_range=window)[1]
+            )
     if index_maps is None:
         index_maps = build_index_maps(records, shard_configs)
     ds = records_to_dataset(
@@ -384,3 +406,227 @@ def read_libsvm(
         id_tags={},
         uids=None,
     )
+
+
+def _iter_part_windows(
+    paths: Sequence[str],
+    row_range: Optional[Tuple[int, int]],
+    part_counts: Optional[Mapping[str, int]],
+):
+    """Yield (part_path, per-part window or None) covering `row_range` across
+    the concatenated part files (both reader engines share this)."""
+    from .avro import count_avro_rows, list_avro_parts
+
+    if row_range is None:
+        for p in paths:
+            for part in list_avro_parts(p):
+                yield part, None
+        return
+    start, stop = row_range
+    offset = 0
+    for p in paths:
+        for part in list_avro_parts(p):
+            if offset >= stop:
+                return
+            if part_counts is not None and part in part_counts:
+                n = part_counts[part]
+            else:
+                n = count_avro_rows(part)
+            lo, hi = max(start - offset, 0), min(stop - offset, n)
+            if lo < hi:
+                yield part, (lo, hi)
+            offset += n
+
+
+def _native_read(
+    paths: Sequence[str],
+    shard_configs: Mapping[str, FeatureShardConfig],
+    index_maps: Optional[Mapping[str, IndexMap]],
+    id_tag_columns: Sequence[str],
+    response_column: str,
+    columns: Optional[InputColumnsNames],
+    row_range: Optional[Tuple[int, int]],
+    part_counts: Optional[Mapping[str, int]],
+) -> Optional[Tuple[RawDataset, Dict[str, IndexMap]]]:
+    """C++ columnar fast path of read_avro_dataset (photon_ml_tpu/native):
+    same semantics as records_to_dataset, vectorized end-to-end. Returns
+    None when the native library is unavailable."""
+    from .. import native
+
+    if not native.available():
+        return None
+
+    col_names = columns or InputColumnsNames()
+
+    # sink layout (same for every part file; absent fields just stay NaN).
+    # Response priority matches records_to_dataset: an explicit remap
+    # outranks response_column.
+    if columns is not None and col_names[RESPONSE] != RESPONSE:
+        resp_order = [col_names[RESPONSE], response_column, "response"]
+    else:
+        resp_order = [response_column, col_names[RESPONSE], "response"]
+    resp_candidates = list(dict.fromkeys(resp_order))
+    num_fields = {name: i for i, name in enumerate(resp_candidates)}
+    off_sink = len(num_fields)
+    num_fields[col_names[OFFSET]] = off_sink
+    wt_sink = off_sink + 1
+    num_fields[col_names[WEIGHT]] = wt_sink
+
+    str_fields = {col_names[UID]: 0}
+    tag_sink = {}       # tag -> top-level sink
+    tag_map_sink = {}   # tag -> metadataMap sink (separate: top-level wins)
+    s = 1
+    for t in id_tag_columns:
+        if t in num_fields:
+            # a tag sharing a numeric column's field name needs dynamic
+            # typing; the Python codec handles it
+            from ..native import ProgramError
+
+            raise ProgramError(
+                f"id tag {t!r} collides with a numeric input column"
+            )
+        if t in str_fields:
+            tag_sink[t] = str_fields[t]  # e.g. tag == uid column: share
+        else:
+            str_fields[t] = s
+            tag_sink[t] = s
+            s += 1
+    map_keys = {}
+    for t in id_tag_columns:
+        tag_map_sink[t] = s
+        map_keys[t] = s
+        s += 1
+
+    all_bags = list(
+        dict.fromkeys(b for cfg in shard_configs.values() for b in cfg.feature_bags)
+    )
+    bag_fields = {b: i for i, b in enumerate(all_bags)}
+
+    # decode every part (respecting the global row window)
+    cols: List[native.Columnar] = []
+    for part, window in _iter_part_windows(paths, row_range, part_counts):
+        cols.append(
+            native.decode_file(
+                part, num_fields, str_fields, bag_fields, map_keys,
+                map_field=col_names[META_DATA_MAP], row_range=window,
+            )
+        )
+
+    n = sum(c.n_rows for c in cols)
+    row_offsets = np.cumsum([0] + [c.n_rows for c in cols])
+
+    def stack_num(sink: int) -> np.ndarray:
+        if not cols:
+            return np.empty(0)
+        return np.concatenate([c.num_cols[sink] for c in cols])
+
+    # response: first non-NaN among the candidates, else 0.0
+    labels = np.zeros(n, dtype=np.float64)
+    filled = np.zeros(n, dtype=bool)
+    for name in resp_candidates:
+        cand = stack_num(num_fields[name])
+        take = ~filled & ~np.isnan(cand)
+        labels[take] = cand[take]
+        filled |= take
+    offs = stack_num(off_sink)
+    offs[np.isnan(offs)] = 0.0
+    wts = stack_num(wt_sink)
+    wts[np.isnan(wts)] = 1.0
+
+    def scatter_str(sink: int, default) -> np.ndarray:
+        out = np.full(n, default, dtype=object)
+        for ci, c in enumerate(cols):
+            rows, vals = c.str_cols[sink]
+            if len(rows):
+                out[rows + row_offsets[ci]] = vals
+        return out
+
+    uids = scatter_str(0, None)
+    id_tags = {}
+    for t in id_tag_columns:
+        # metadataMap first, then top-level (rec.get(t) wins over meta.get(t))
+        out = scatter_str(tag_map_sink[t], "")
+        for ci, c in enumerate(cols):
+            rows, vals = c.str_cols[tag_sink[t]]
+            if len(rows):
+                out[rows + row_offsets[ci]] = vals
+        id_tags[t] = out
+
+    # per-bag global triples with keys resolved per part
+    bag_triples: Dict[str, List[Tuple[np.ndarray, np.ndarray, np.ndarray]]] = {
+        b: [] for b in all_bags
+    }
+    for ci, c in enumerate(cols):
+        for b, bi in bag_fields.items():
+            rows, kid, vals, keys = c.bags[bi]
+            if len(rows):
+                bag_triples[b].append((rows + row_offsets[ci], kid, vals, keys))
+
+    building_maps = index_maps is None
+    if building_maps:
+        shard_keys = {}
+        for shard, cfg in shard_configs.items():
+            ks: set = set()
+            for b in cfg.feature_bags:
+                for _, _, _, keys in bag_triples[b]:
+                    ks.update(keys.tolist())
+            shard_keys[shard] = ks
+        index_maps = {
+            shard: IndexMap.from_keys(
+                shard_keys[shard], add_intercept=shard_configs[shard].has_intercept
+            )
+            for shard in shard_configs
+        }
+
+    shard_coo = {}
+    for shard, cfg in shard_configs.items():
+        imap = index_maps[shard]
+        rs, cs, vs = [], [], []
+        for b in cfg.feature_bags:
+            for rows, kid, vals, keys in bag_triples[b]:
+                # vectorized key -> column: lookup only the unique keys
+                key_cols = np.fromiter(
+                    (imap.get_index(k) for k in keys), dtype=np.int64,
+                    count=len(keys),
+                )
+                col_of = key_cols[kid]
+                keep = col_of >= 0
+                rs.append(rows[keep])
+                cs.append(col_of[keep])
+                vs.append(vals[keep])
+        if rs:
+            rows = np.concatenate(rs)
+            colsv = np.concatenate(cs)
+            vals = np.concatenate(vs)
+            # last-wins dedupe on (row, col): bag order then input order,
+            # matching _merge_bags' dict semantics
+            d = len(imap)
+            keys64 = rows * np.int64(d + 1) + colsv
+            order = np.arange(len(keys64), dtype=np.int64)
+            idx = np.lexsort((order, keys64))
+            ks = keys64[idx]
+            last = idx[np.r_[ks[1:] != ks[:-1], True]] if len(ks) else idx
+            rows, colsv, vals = rows[last], colsv[last], vals[last]
+        else:
+            rows = np.empty(0, np.int64)
+            colsv = np.empty(0, np.int64)
+            vals = np.empty(0, np.float64)
+        if cfg.has_intercept:
+            j = imap.get_index(INTERCEPT_KEY)
+            if j >= 0:
+                rows = np.concatenate([rows, np.arange(n, dtype=np.int64)])
+                colsv = np.concatenate([colsv, np.full(n, j, dtype=np.int64)])
+                vals = np.concatenate([vals, np.ones(n)])
+        shard_coo[shard] = (rows, colsv, vals)
+
+    ds = RawDataset(
+        n_rows=n,
+        labels=labels,
+        offsets=offs,
+        weights=wts,
+        shard_coo=shard_coo,
+        shard_dims={s_: len(index_maps[s_]) for s_ in shard_configs},
+        id_tags=id_tags,
+        uids=uids,
+    )
+    return ds, dict(index_maps)
