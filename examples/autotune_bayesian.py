@@ -68,6 +68,25 @@ def main():
         json.dump(result, f, indent=2)
     print(json.dumps({k: v for k, v in result.items() if k != "curve"}))
     assert result["tuned_best_loss"] < result["grid_loss"] - 0.05, result
+
+    # smoothed-hinge SVM leg (the BASELINE config pairs the autotune with the
+    # reference's experimental linear SVM task)
+    svm = train.run(
+        [
+            "--input-data", HEART,
+            "--validation-data", HEART,
+            "--task", "smoothed_hinge_loss_linear_svm",
+            "--feature-shard", "name=global,bags=features",
+            "--coordinate",
+            "name=global,shard=global,optimizer=LBFGS,reg.type=L2,reg.weights=1",
+            "--normalization", "STANDARDIZATION",
+            "--evaluators", "AUC",
+            "--output-dir", os.path.join(args.out, "svm"),
+        ]
+    )
+    svm_auc = svm["best"]["metrics"]["AUC"]
+    print(json.dumps({"config": "smoothed-hinge-svm-heart", "auc": svm_auc}))
+    assert svm_auc > 0.85
     return result
 
 

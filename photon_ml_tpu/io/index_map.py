@@ -29,6 +29,7 @@ INTERCEPT_TERM = ""
 INTERCEPT_KEY = INTERCEPT_NAME + DELIMITER + INTERCEPT_TERM
 
 _MAGIC = b"PHIDX001"
+_MAGIC2 = b"PHIDX002"  # key-sorted, mmap-searchable (MmapIndexMap)
 
 
 def feature_key(name: str, term: str = "") -> str:
@@ -105,18 +106,11 @@ class IndexMap:
         and maps to indices[k] — indices are stored explicitly, so a store may
         hold any subset of a global map (hash partitions included). Loading is
         one read + two numpy views (the "off-heap store" role of PalDBIndexMap)."""
-        items = sorted(self._k2i.items(), key=lambda kv: kv[1])
-        n = len(items)
-        encoded = [k.encode("utf-8") for k, _ in items]
-        indices = np.asarray([i for _, i in items], dtype=np.int64)
-        offsets = np.zeros(n + 1, dtype=np.int64)
-        np.cumsum([len(e) for e in encoded], out=offsets[1:])
-        with open(path, "wb") as f:
-            f.write(_MAGIC)
-            f.write(struct.pack("<q", n))
-            f.write(offsets.tobytes())
-            f.write(indices.tobytes())
-            f.write(b"".join(encoded))
+        entries = [
+            (k.encode("utf-8"), i)
+            for k, i in sorted(self._k2i.items(), key=lambda kv: kv[1])
+        ]
+        _write_store(_MAGIC, entries, path)
 
     @staticmethod
     def load(path: str) -> "IndexMap":
@@ -136,27 +130,56 @@ class IndexMap:
 
 
 def save_partitioned(index_map: IndexMap, out_dir: str, num_partitions: int, shard: str):
-    """Write the index as hash-partitioned stores + metadata, matching the
-    layout produced by FeatureIndexingDriver (one store per partition;
+    """Write the index as hash-partitioned mmap stores + metadata, matching
+    the layout produced by FeatureIndexingDriver (one store per partition;
     partition = hash(key) % n, PalDBIndexMap.scala:69-105 semantics)."""
     os.makedirs(out_dir, exist_ok=True)
     parts: List[Dict[str, int]] = [dict() for _ in range(num_partitions)]
     for k, i in index_map.items():
         parts[_partition(k, num_partitions)][k] = i
     for p, mapping in enumerate(parts):
-        IndexMap(mapping).save(os.path.join(out_dir, f"index-{shard}-{p:05d}.bin"))
+        MmapIndexMap.write(
+            mapping.items(), os.path.join(out_dir, f"index-{shard}-{p:05d}.bin")
+        )
     with open(os.path.join(out_dir, f"_index-{shard}-meta.json"), "w") as f:
         json.dump({"shard": shard, "numPartitions": num_partitions, "size": len(index_map)}, f)
 
 
-def load_partitioned(out_dir: str, shard: str) -> IndexMap:
+def load_partitioned(out_dir: str, shard: str):
+    """Open the partitioned stores as zero-heap mmap views (v2 'PHIDX002'
+    layout); v1 'PHIDX001' stores from older runs load into an in-memory
+    IndexMap for compatibility."""
     with open(os.path.join(out_dir, f"_index-{shard}-meta.json")) as f:
         meta = json.load(f)
+    part_paths = [
+        os.path.join(out_dir, f"index-{shard}-{p:05d}.bin")
+        for p in range(meta["numPartitions"])
+    ]
+    with open(part_paths[0], "rb") as f:
+        magic = f.read(8)
+    if magic == _MAGIC2:
+        return PartitionedIndexMap(
+            [MmapIndexMap.open(p) for p in part_paths], meta["size"]
+        )
     merged: Dict[str, int] = {}
-    for p in range(meta["numPartitions"]):
-        part = IndexMap.load(os.path.join(out_dir, f"index-{shard}-{p:05d}.bin"))
-        merged.update(part.items())
+    for p in part_paths:
+        merged.update(IndexMap.load(p).items())
     return IndexMap(merged)
+
+
+def _write_store(magic: bytes, entries: List[Tuple[bytes, int]], path: str):
+    """Shared v1/v2 store layout: magic, i64 n, i64 offsets[n+1], i64
+    indices[n], key blob. v1 orders entries by index, v2 by key."""
+    n = len(entries)
+    offsets = np.zeros(n + 1, dtype=np.int64)
+    np.cumsum([len(k) for k, _ in entries], out=offsets[1:])
+    indices = np.asarray([i for _, i in entries], dtype=np.int64)
+    with open(path, "wb") as f:
+        f.write(magic)
+        f.write(struct.pack("<q", n))
+        f.write(offsets.tobytes())
+        f.write(indices.tobytes())
+        f.write(b"".join(k for k, _ in entries))
 
 
 def _partition(key: str, n: int) -> int:
@@ -165,3 +188,166 @@ def _partition(key: str, n: int) -> int:
     for b in key.encode("utf-8"):
         h = ((h ^ b) * 16777619) & 0xFFFFFFFF
     return h % n
+
+
+class MmapIndexMap:
+    """Zero-heap, memory-mapped index store: the PalDBIndexMap role
+    (photon-api .../index/PalDBIndexMap.scala:43-278 — thousands of executors
+    mmap one immutable off-heap store instead of materializing per-process
+    hashmaps). The v2 store keeps entries sorted BY KEY, so lookups are
+    binary searches over the mapped key blob — nothing is copied onto the
+    Python heap; the OS page cache is shared across processes on a host.
+
+    Interface-compatible with IndexMap (get_index / get_feature_name /
+    items / intercept_index), so every consumer takes either."""
+
+    def __init__(self, mm, offsets: np.ndarray, indices: np.ndarray,
+                 blob_start: int, path: str):
+        self._mm = mm
+        self._offsets = offsets      # i64[n+1] into the key blob (key-sorted)
+        self._indices = indices      # i64[n]  global index per sorted key
+        self._blob_start = blob_start
+        self._path = path
+        self._rev: Optional[np.ndarray] = None  # index -> sorted-entry pos
+
+    def __len__(self) -> int:
+        return len(self._indices)
+
+    @property
+    def size(self) -> int:
+        return len(self._indices)
+
+    def _key_at(self, k: int) -> bytes:
+        s = self._blob_start
+        return bytes(self._mm[s + self._offsets[k]: s + self._offsets[k + 1]])
+
+    def get_index(self, key: str) -> int:
+        target = key.encode("utf-8")
+        lo, hi = 0, len(self._indices)
+        while lo < hi:
+            mid = (lo + hi) // 2
+            k = self._key_at(mid)
+            if k < target:
+                lo = mid + 1
+            elif k > target:
+                hi = mid
+            else:
+                return int(self._indices[mid])
+        return -1
+
+    def __contains__(self, key: str) -> bool:
+        return self.get_index(key) >= 0
+
+    def get_feature_name(self, index: int) -> Optional[str]:
+        if self._rev is None:
+            self._rev = np.argsort(self._indices)
+        pos = np.searchsorted(self._indices, index, sorter=self._rev)
+        if pos >= len(self._indices):
+            return None
+        entry = int(self._rev[pos])
+        if int(self._indices[entry]) != index:
+            return None
+        return self._key_at(entry).decode("utf-8")
+
+    @property
+    def intercept_index(self) -> Optional[int]:
+        idx = self.get_index(INTERCEPT_KEY)
+        return None if idx < 0 else idx
+
+    def keys(self) -> Iterator[str]:
+        for k in range(len(self._indices)):
+            yield self._key_at(k).decode("utf-8")
+
+    def items(self) -> Iterator[Tuple[str, int]]:
+        for k in range(len(self._indices)):
+            yield self._key_at(k).decode("utf-8"), int(self._indices[k])
+
+    # -- store --------------------------------------------------------------
+
+    @staticmethod
+    def write(items: Iterable[Tuple[str, int]], path: str):
+        """Write a key-sorted v2 store ('PHIDX002')."""
+        _write_store(
+            _MAGIC2, sorted((k.encode("utf-8"), i) for k, i in items), path
+        )
+
+    @staticmethod
+    def open(path: str) -> "MmapIndexMap":
+        import mmap as _mmap
+
+        f = open(path, "rb")
+        mm = _mmap.mmap(f.fileno(), 0, access=_mmap.ACCESS_READ)
+        f.close()
+        if mm[:8] != _MAGIC2:
+            raise ValueError(f"{path}: bad v2 index store magic {bytes(mm[:8])!r}")
+        (n,) = struct.unpack("<q", mm[8:16])
+        off0 = 16
+        offsets = np.frombuffer(mm, dtype=np.int64, count=n + 1, offset=off0)
+        indices = np.frombuffer(
+            mm, dtype=np.int64, count=n, offset=off0 + 8 * (n + 1)
+        )
+        blob_start = off0 + 8 * (n + 1) + 8 * n
+        return MmapIndexMap(mm, offsets, indices, blob_start, path)
+
+
+class PartitionedIndexMap:
+    """Hash-partitioned set of mmap stores looked up per key — the
+    PalDBIndexMap partition routing (getIndex hashes the key to pick the
+    store, PalDBIndexMap.scala:69-105). Same interface as IndexMap."""
+
+    def __init__(self, parts: List[MmapIndexMap], size: int):
+        self._parts = parts
+        self._size = size
+        # per-occurrence ingest calls get_index once per feature instance;
+        # memoize resolved keys so repeats are dict hits, not binary searches
+        self._memo: Dict[str, int] = {}
+        self._rev_part: Optional[np.ndarray] = None
+        self._rev_entry: Optional[np.ndarray] = None
+
+    def __len__(self) -> int:
+        return self._size
+
+    @property
+    def size(self) -> int:
+        return self._size
+
+    def get_index(self, key: str) -> int:
+        idx = self._memo.get(key)
+        if idx is None:
+            idx = self._parts[_partition(key, len(self._parts))].get_index(key)
+            self._memo[key] = idx
+        return idx
+
+    def __contains__(self, key: str) -> bool:
+        return self.get_index(key) >= 0
+
+    def _build_reverse(self):
+        # one-time merged reverse map: global index -> (partition, entry)
+        self._rev_part = np.full(self._size, -1, dtype=np.int32)
+        self._rev_entry = np.zeros(self._size, dtype=np.int64)
+        for pi, p in enumerate(self._parts):
+            idx = p._indices
+            ok = (idx >= 0) & (idx < self._size)
+            self._rev_part[idx[ok]] = pi
+            self._rev_entry[idx[ok]] = np.flatnonzero(ok)
+
+    def get_feature_name(self, index: int) -> Optional[str]:
+        if self._rev_part is None:
+            self._build_reverse()
+        if not (0 <= index < self._size) or self._rev_part[index] < 0:
+            return None
+        part = self._parts[int(self._rev_part[index])]
+        return part._key_at(int(self._rev_entry[index])).decode("utf-8")
+
+    @property
+    def intercept_index(self) -> Optional[int]:
+        idx = self.get_index(INTERCEPT_KEY)
+        return None if idx < 0 else idx
+
+    def keys(self) -> Iterator[str]:
+        for p in self._parts:
+            yield from p.keys()
+
+    def items(self) -> Iterator[Tuple[str, int]]:
+        for p in self._parts:
+            yield from p.items()

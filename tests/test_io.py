@@ -409,3 +409,61 @@ def test_date_ranges_and_day_dirs(tmp_path):
         input_paths_within_date_range(
             str(base), DateRange.from_string("20300101-20300102")
         )
+
+
+def test_mmap_index_store_round_trip(tmp_path):
+    """v2 key-sorted mmap stores: binary-search lookups, reverse lookup,
+    iteration, and partition routing (PalDBIndexMap.scala:69-105 role)."""
+    from photon_ml_tpu.io.index_map import (
+        INTERCEPT_KEY,
+        IndexMap,
+        MmapIndexMap,
+        PartitionedIndexMap,
+        load_partitioned,
+        save_partitioned,
+    )
+
+    keys = [f"f{i:04d}\x01t{i % 7}" for i in range(500)]
+    imap = IndexMap.from_keys(keys, add_intercept=True)
+
+    p = str(tmp_path / "store.bin")
+    MmapIndexMap.write(imap.items(), p)
+    mm = MmapIndexMap.open(p)
+    assert len(mm) == len(imap)
+    for k in [keys[0], keys[123], keys[-1], INTERCEPT_KEY]:
+        assert mm.get_index(k) == imap.get_index(k)
+    assert mm.get_index("nope") == -1
+    assert "nope" not in mm and keys[3] in mm
+    assert mm.intercept_index == imap.intercept_index
+    for i in (0, 17, len(imap) - 1):
+        assert mm.get_feature_name(i) == imap.get_feature_name(i)
+    assert mm.get_feature_name(len(imap) + 5) is None
+    assert dict(mm.items()) == dict(imap.items())
+
+    # partitioned layout end-to-end (what cli.index writes / cli.train loads)
+    out = str(tmp_path / "parts")
+    save_partitioned(imap, out, num_partitions=4, shard="global")
+    part = load_partitioned(out, "global")
+    assert isinstance(part, PartitionedIndexMap)
+    assert len(part) == len(imap)
+    for k in keys[::37] + [INTERCEPT_KEY]:
+        assert part.get_index(k) == imap.get_index(k)
+    assert part.get_index("missing") == -1
+    assert part.get_feature_name(3) == imap.get_feature_name(3)
+    assert dict(part.items()) == dict(imap.items())
+
+
+def test_v1_index_store_still_loads(tmp_path):
+    from photon_ml_tpu.io.index_map import IndexMap, load_partitioned
+
+    imap = IndexMap.from_keys(["a\x01", "b\x01x"], add_intercept=True)
+    out = str(tmp_path / "v1")
+    import json
+    import os
+
+    os.makedirs(out)
+    imap.save(os.path.join(out, "index-g-00000.bin"))  # v1 single partition
+    with open(os.path.join(out, "_index-g-meta.json"), "w") as f:
+        json.dump({"shard": "g", "numPartitions": 1, "size": len(imap)}, f)
+    loaded = load_partitioned(out, "g")
+    assert dict(loaded.items()) == dict(imap.items())
