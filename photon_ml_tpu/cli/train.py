@@ -125,6 +125,13 @@ def build_parser() -> argparse.ArgumentParser:
         "model axis shards the coefficient dim of layout=tiled coordinates",
     )
     p.add_argument(
+        "--checkpoint-dir",
+        default=None,
+        help="save the model after every coordinate-descent sweep; rerunning "
+        "the same single-config command resumes from the last completed "
+        "sweep (crash recovery for long runs)",
+    )
+    p.add_argument(
         "--distributed",
         default=None,
         help="multi-host: 'coordinator=HOST:PORT,process=I,n=P' (or 'auto' "
@@ -286,17 +293,28 @@ def run(argv: Optional[List[str]] = None) -> Dict:
 
     evaluators = [e for e in args.evaluators.split(",") if e]
     mesh = parse_mesh_shape(args.mesh_shape)
+
+    n_cd_iterations = args.coordinate_descent_iterations
+    checkpoint_fn = None
+    if args.checkpoint_dir:
+        initial_model, n_cd_iterations, checkpoint_fn = _setup_checkpointing(
+            args, coords, index_maps, initial_model, n_cd_iterations
+        )
+
     estimator = GameEstimator(
         task=args.task,
         coordinate_configs=coords,
-        n_cd_iterations=args.coordinate_descent_iterations,
+        n_cd_iterations=n_cd_iterations,
         evaluator_specs=evaluators,
         partial_retrain_locked=[
             c for c in args.partial_retrain_locked.split(",") if c
         ],
         mesh=mesh,
     )
-    results = estimator.fit(raw, validation=validation, initial_model=initial_model)
+    results = estimator.fit(
+        raw, validation=validation, initial_model=initial_model,
+        checkpoint_fn=checkpoint_fn,
+    )
 
     # optional hyperparameter auto-tuning (GameTrainingDriver:642-673)
     tuned_results: List[GameResult] = []
@@ -427,6 +445,99 @@ def _run_tuning(args, estimator, raw, validation, coords, prior_results):
         with open(os.path.join(args.output_dir, "hyperparameter-prior.json"), "w") as f:
             f.write(prior_to_json(names, priors))
     return results
+
+
+def _setup_checkpointing(args, coords, index_maps, initial_model, n_iterations):
+    """Per-sweep checkpointing (crash recovery beyond the reference's
+    model-granularity warm start): after every completed CD sweep the model
+    lands in --checkpoint-dir/model-<k> and the state record flips to it
+    ATOMICALLY (a crash mid-save leaves the state pointing at the previous
+    intact model). Rerunning the same command warm-starts from the last
+    completed sweep and trains only the remainder. Restricted to
+    single-configuration runs (grids would need per-config state).
+
+    With --validation-data, best-model tracking restarts at the resume point:
+    pre-crash sweeps are no longer best-model candidates (the checkpoint
+    stores last-sweep models, not the tracked best)."""
+    grid_size = 1
+    for cc in coords:
+        grid_size *= max(len(cc.grid()), 1)
+    if grid_size != 1:
+        raise SystemExit(
+            "--checkpoint-dir requires a single configuration (no reg-weight "
+            "grids); tune weights first, then run the long job checkpointed"
+        )
+    if args.validation_data:
+        logger.warning(
+            "--checkpoint-dir with --validation-data: on resume, best-model "
+            "tracking only sees post-resume sweeps (pre-crash candidates are "
+            "not checkpointed)"
+        )
+    from ..parallel import multihost
+
+    ckpt_dir = args.checkpoint_dir
+    state_path = os.path.join(ckpt_dir, "checkpoint-state.json")
+    expected = {cc.name: float(cc.grid()[0]) for cc in coords}
+
+    completed = 0
+    if os.path.exists(state_path):
+        with open(state_path) as f:
+            state = json.load(f)
+        if state.get("reg_weights") != expected:
+            raise SystemExit(
+                f"checkpoint at {ckpt_dir} was written for config "
+                f"{state.get('reg_weights')}, not {expected}; pass a fresh "
+                "--checkpoint-dir"
+            )
+        completed = int(state.get("completed_sweeps", 0))
+        if completed >= n_iterations:
+            raise SystemExit(
+                f"checkpoint at {ckpt_dir} already records {completed}/"
+                f"{n_iterations} completed sweeps; the final model is in "
+                f"{os.path.join(ckpt_dir, state.get('model_dir', 'model'))} "
+                "(loadable via --model-input-dir). Pass a fresh "
+                "--checkpoint-dir or more --coordinate-descent-iterations "
+                "to train further."
+            )
+        if completed > 0:
+            initial_model = load_game_model(
+                os.path.join(ckpt_dir, state["model_dir"]), index_maps,
+                task=args.task,
+            )
+            logger.info(
+                "resuming from checkpoint: %d/%d sweeps done", completed,
+                n_iterations,
+            )
+    remaining = n_iterations - completed
+
+    def checkpoint_fn(reg_weights, iteration, game_model):
+        if not multihost.is_coordinator():
+            return
+        k = completed + iteration + 1
+        model_dir = f"model-{k:04d}"
+        save_game_model(
+            os.path.join(ckpt_dir, model_dir), game_model, index_maps,
+            metadata={"regWeights": reg_weights},
+        )
+        with open(state_path + ".tmp", "w") as f:
+            json.dump(
+                {
+                    "reg_weights": expected,
+                    "completed_sweeps": k,
+                    "model_dir": model_dir,
+                },
+                f,
+            )
+        os.replace(state_path + ".tmp", state_path)  # atomic flip
+        # previous sweep's model is now unreferenced
+        prev = os.path.join(ckpt_dir, f"model-{k - 1:04d}")
+        if os.path.isdir(prev):
+            import shutil
+
+            shutil.rmtree(prev, ignore_errors=True)
+
+    os.makedirs(ckpt_dir, exist_ok=True)
+    return initial_model, remaining, checkpoint_fn
 
 
 def _native_vec(result: GameResult, names: List[str]) -> np.ndarray:

@@ -258,7 +258,10 @@ class GameEstimator(EventEmitter):
         raw: RawDataset,
         validation: Optional[RawDataset] = None,
         initial_model: Optional[GameModel] = None,
+        checkpoint_fn: Optional[object] = None,
     ) -> List[GameResult]:
+        """``checkpoint_fn(reg_weights, iteration, game_model)`` runs after
+        each completed coordinate-descent sweep of each configuration."""
         datasets = self._prepare_datasets(raw)
         validation_ctx = None
         if validation is not None:
@@ -278,8 +281,15 @@ class GameEstimator(EventEmitter):
         for combo in itertools.product(*grids):
             reg_weights = dict(zip(names, combo))
             coords = self._make_coordinates(datasets, reg_weights, prev_models)
+            cd_ckpt = None
+            if checkpoint_fn is not None:
+                task = self.task
+                cd_ckpt = lambda it, models, _w=reg_weights: checkpoint_fn(
+                    _w, it, GameModel(models=models, task=task)
+                )
             cd = CoordinateDescent(
-                coords, n_iterations=self.n_cd_iterations, validation=validation_ctx
+                coords, n_iterations=self.n_cd_iterations,
+                validation=validation_ctx, checkpoint_fn=cd_ckpt,
             )
             with timed(f"train config {reg_weights}", logging.INFO):
                 out = cd.run(initial_models=prev_models)

@@ -123,7 +123,11 @@ class FixedEffectCoordinate(Coordinate):
 
     def score(self, model: FixedEffectModel) -> Array:
         feats = self.dataset.batch.features
-        means = model.model.coefficients.means
+        # compute in the dataset's dtype: a warm-start model loaded under an
+        # x64 config is f64 and must not promote the f32 score/residual stream
+        means = jnp.asarray(
+            model.model.coefficients.means, self.dataset.batch.labels.dtype
+        )
         d_pad = feats.dim - means.shape[0]
         if d_pad > 0:
             means = jnp.concatenate([means, jnp.zeros((d_pad,), means.dtype)])
@@ -254,6 +258,11 @@ class RandomEffectCoordinate(Coordinate):
             re_np = np.asarray(row_entity)
             mapped = np.where(re_np >= 0, block_to_model[np.maximum(re_np, 0)], -1)
             row_entity = jnp.asarray(mapped.astype(np.int32))
+        ds_dtype = self.dataset.ell_val.dtype
+        if model.coef_values.dtype != ds_dtype:
+            model = dataclasses.replace(
+                model, coef_values=jnp.asarray(model.coef_values, ds_dtype)
+            )
         return model.score_ell_rows(row_entity, self.dataset.ell_idx, self.dataset.ell_val)
 
 

@@ -57,7 +57,12 @@ class CoordinateDescent:
         coordinates: Mapping[str, Coordinate],  # ordered
         n_iterations: int = 1,
         validation: Optional[ValidationContext] = None,
+        checkpoint_fn: Optional[object] = None,
     ):
+        """``checkpoint_fn(iteration, models)`` runs after each completed
+        sweep (crash recovery for long runs: resume = warm-start from the
+        checkpointed models with the remaining iterations; the score state
+        reconstructs exactly from the models)."""
         if not coordinates:
             raise ValueError("CoordinateDescent needs at least one coordinate")
         if n_iterations < 1:
@@ -69,6 +74,7 @@ class CoordinateDescent:
         self.order = list(coordinates)
         self.n_iterations = n_iterations
         self.validation = validation
+        self.checkpoint_fn = checkpoint_fn
         n_trainable = sum(
             0 if isinstance(c, ModelCoordinate) else 1 for c in self.coordinates.values()
         )
@@ -152,6 +158,8 @@ class CoordinateDescent:
                     logger.info(
                         "cd iter %d coordinate %s: %s", it, name, res.metrics
                     )
+            if self.checkpoint_fn is not None:
+                self.checkpoint_fn(it, dict(models))
 
         final_models = best_models if best_eval is not None else models
         task = self._infer_task()

@@ -196,3 +196,101 @@ def test_hyperparameter_tuning_bayesian_end_to_end(avro_paths, tmp_path):
         ]
     )
     assert shrunk["best"]["metrics"]["LOGISTIC_LOSS"] < grid_loss - 0.01
+
+
+def test_checkpoint_resume_matches_straight_run(avro_paths, tmp_path):
+    """--checkpoint-dir: a run interrupted after 2 of 4 sweeps resumes from
+    the checkpoint and its final model matches a straight 4-sweep run
+    (no validation: best-model tracking would compare different windows)."""
+    train_p, _ = avro_paths
+    ckpt = str(tmp_path / "ckpt")
+    common = [
+        "--input-data", train_p,
+        "--task", "logistic_regression",
+        "--feature-shard", "name=globalShard,bags=features",
+        "--feature-shard", "name=userShard,bags=userFeatures",
+        "--coordinate",
+        "name=global,shard=globalShard,optimizer=LBFGS,reg.type=L2,reg.weights=1",
+        "--coordinate",
+        "name=per-user,shard=userShard,re.type=userId,reg.type=L2,reg.weights=1",
+    ]
+    # "interrupted" run: only 2 sweeps happen, each checkpointed
+    train.run(common + [
+        "--coordinate-descent-iterations", "2",
+        "--checkpoint-dir", ckpt,
+        "--output-dir", str(tmp_path / "out1"),
+    ])
+    with open(os.path.join(ckpt, "checkpoint-state.json")) as f:
+        state = json.load(f)
+    assert state["completed_sweeps"] == 2
+
+    # resume: same command, full 4 sweeps -> trains only the remaining 2
+    train.run(common + [
+        "--coordinate-descent-iterations", "4",
+        "--checkpoint-dir", ckpt,
+        "--output-dir", str(tmp_path / "out2"),
+    ])
+    with open(os.path.join(ckpt, "checkpoint-state.json")) as f:
+        assert json.load(f)["completed_sweeps"] == 4
+
+    train.run(common + [
+        "--coordinate-descent-iterations", "4",
+        "--output-dir", str(tmp_path / "out3"),
+    ])
+
+    from photon_ml_tpu.io import FeatureShardConfig, read_avro_dataset
+    from photon_ml_tpu.io.model_io import load_game_model
+
+    _, imaps = read_avro_dataset(
+        train_p,
+        {
+            "globalShard": FeatureShardConfig(("features",)),
+            "userShard": FeatureShardConfig(("userFeatures",)),
+        },
+    )
+    m_resumed = load_game_model(
+        os.path.join(str(tmp_path / "out2"), "models", "best"), imaps,
+        task="logistic_regression",
+    )
+    m_straight = load_game_model(
+        os.path.join(str(tmp_path / "out3"), "models", "best"), imaps,
+        task="logistic_regression",
+    )
+    # f32 solves re-entered through a save/load roundtrip reorder a few
+    # floating-point ops; agreement here is ~1e-5 absolute
+    np.testing.assert_allclose(
+        np.asarray(m_resumed.models["global"].model.coefficients.means),
+        np.asarray(m_straight.models["global"].model.coefficients.means),
+        rtol=5e-3, atol=1e-4,
+    )
+    np.testing.assert_allclose(
+        np.asarray(m_resumed.models["per-user"].coef_values),
+        np.asarray(m_straight.models["per-user"].coef_values),
+        rtol=5e-3, atol=1e-4,
+    )
+
+    # rerunning a fully-completed checkpointed job is refused (idempotency)
+    with pytest.raises(SystemExit, match="already records"):
+        train.run(common + [
+            "--coordinate-descent-iterations", "4",
+            "--checkpoint-dir", ckpt,
+            "--output-dir", str(tmp_path / "out6"),
+        ])
+
+    # config mismatch is refused
+    with pytest.raises(SystemExit, match="was written for config"):
+        train.run(common[:-2] + [
+            "--coordinate",
+            "name=per-user,shard=userShard,re.type=userId,reg.type=L2,reg.weights=7",
+            "--coordinate-descent-iterations", "2",
+            "--checkpoint-dir", ckpt,
+            "--output-dir", str(tmp_path / "out4"),
+        ])
+    # grids are rejected
+    with pytest.raises(SystemExit, match="single configuration"):
+        train.run(common[:-2] + [
+            "--coordinate",
+            "name=per-user,shard=userShard,re.type=userId,reg.type=L2,reg.weights=1|10",
+            "--checkpoint-dir", str(tmp_path / "ckpt2"),
+            "--output-dir", str(tmp_path / "out5"),
+        ])
