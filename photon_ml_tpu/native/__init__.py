@@ -299,11 +299,20 @@ def decode_file(
     except ValueError:
         f.close()
         raise ValueError(f"{path}: not an Avro object container file")
-    with f, data:
-        return _decode_mapped(
-            lib, path, data, num_fields, str_fields, bag_fields, map_keys,
-            map_field, row_range, _program_cache,
-        )
+    with f:
+        try:
+            return _decode_mapped(
+                lib, path, data, num_fields, str_fields, bag_fields, map_keys,
+                map_field, row_range, _program_cache,
+            )
+        finally:
+            try:
+                data.close()
+            except BufferError:
+                # a propagating exception's traceback still holds the
+                # np.frombuffer view; let GC close the map rather than
+                # masking the real error with BufferError
+                pass
 
 
 def _decode_mapped(lib, path, data, num_fields, str_fields, bag_fields,

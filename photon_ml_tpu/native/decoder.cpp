@@ -212,6 +212,12 @@ bool capture_map_value(Ctx& c, const int32_t* val, int32_t route) {
     store_str(c, route, buf, n);
     return true;
   }
+  if (vop == OP_BOOL) {
+    if (!c.need(1)) return false;
+    bool v = *c.p++ != 0;
+    store_str(c, route, v ? "True" : "False", v ? 4 : 5);
+    return true;
+  }
   if (vop == OP_NULL) return true;
   if (vop == OP_UNION) {
     int64_t idx;

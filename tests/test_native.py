@@ -298,3 +298,120 @@ def test_native_numeric_uid_and_tag(tmp_path):
     _assert_dataset_equal(auto, py, sh)
     assert list(auto.uids) == ["42", "abc", None]
     assert list(auto.id_tags["groupId"]) == ["7", "8", "9"]
+
+
+def test_native_randomized_schema_parity(tmp_path):
+    """Fuzz: random record schemas (numeric/string unions, optional bags,
+    maps, enums/fixed to skip, both codecs) must decode identically through
+    both engines across many draws. engine='native' so a decoder crash or
+    unsupported-shape fallback FAILS the test instead of silently comparing
+    Python against itself."""
+    import random
+
+    rng = random.Random(20260730)
+
+    def random_schema(case):
+        fields = [{"name": "label", "type": rng.choice(
+            ["double", ["int", "double"], ["double", "float", "int", "long", "boolean", "string"]])}]
+        if rng.random() < 0.7:
+            fields.append({"name": "weight", "type": rng.choice(
+                ["float", ["null", "float"], ["null", "int", "double"]]), "default": None})
+        if rng.random() < 0.7:
+            fields.append({"name": "offset", "type": ["null", "long", "double"], "default": None})
+        if rng.random() < 0.6:
+            fields.append({"name": "uid", "type": ["null", "string", "long", "int"], "default": None})
+        # a field the reader must skip, of annoying shape
+        fields.append({"name": f"junk{case}", "type": rng.choice([
+            {"type": "enum", "name": f"E{case}", "symbols": ["A", "B", "C"]},
+            {"type": "fixed", "name": f"X{case}", "size": 5},
+            {"type": "array", "items": ["null", "string", "double"]},
+            {"type": "map", "values": ["null", "long"]},
+            {"type": "record", "name": f"N{case}", "fields": [
+                {"name": "a", "type": ["null", "string"]},
+                {"name": "b", "type": "double"}]},
+        ])})
+        if rng.random() < 0.8:
+            fields.append({"name": "metadataMap", "type": rng.choice([
+                {"type": "map", "values": "string"},
+                ["null", {"type": "map", "values": ["boolean", "long", "string"]}],
+            ]), "default": None})
+        term_type = rng.choice(["string", ["null", "string"]])
+        value_type = rng.choice(["double", "float", ["int", "double"]])
+        fields.append({"name": "features", "type": {"type": "array", "items": {
+            "type": "record", "name": "FeatureAvro", "fields": [
+                {"name": "name", "type": "string"},
+                {"name": "term", "type": term_type},
+                {"name": "value", "type": value_type}]}}})
+        return {"type": "record", "name": f"Case{case}", "fields": fields}
+
+    def random_value(t, case):
+        if isinstance(t, list):
+            return random_value(rng.choice(t), case)
+        if isinstance(t, dict):
+            tt = t["type"]
+            if tt == "enum":
+                return rng.choice(t["symbols"])
+            if tt == "fixed":
+                return bytes(rng.randrange(256) for _ in range(t["size"]))
+            if tt == "array":
+                return [random_value(t["items"], case) for _ in range(rng.randrange(3))]
+            if tt == "map":
+                return {f"k{i}": random_value(t["values"], case) for i in range(rng.randrange(3))}
+            if tt == "record":
+                return {f["name"]: random_value(f["type"], case) for f in t["fields"]}
+        if t == "null":
+            return None
+        if t == "boolean":
+            return rng.random() < 0.5
+        if t in ("int", "long"):
+            return rng.randrange(-1000, 1000)
+        if t in ("float", "double"):
+            return round(rng.uniform(-5, 5), 3)
+        if t == "string":
+            return rng.choice(["0.5", "x", "café", "", "-3"])  # some parse as numbers
+        if t == "bytes":
+            return b"bb"
+        raise AssertionError(t)
+
+    n_mismatch = 0
+    for case in range(20):
+        schema = random_schema(case)
+        by_name = {f["name"]: f["type"] for f in schema["fields"]}
+        recs = []
+        for i in range(rng.randrange(1, 40)):
+            rec = {}
+            for f in schema["fields"]:
+                if f["name"] == "label":
+                    # labels must be numeric-parseable for _num parity
+                    t = f["type"]
+                    while True:
+                        v = random_value(t, case)
+                        try:
+                            float(v)
+                            break
+                        except (TypeError, ValueError):
+                            continue
+                    rec["label"] = v
+                elif f["name"] == "features":
+                    rec["features"] = [
+                        {"name": f"f{rng.randrange(6)}",
+                         "term": random_value(by_name["features"]["items"]["fields"][1]["type"], case),
+                         "value": random_value(by_name["features"]["items"]["fields"][2]["type"], case)}
+                        for _ in range(rng.randrange(5))
+                    ]
+                else:
+                    rec[f["name"]] = random_value(f["type"], case)
+            recs.append(rec)
+        p = str(tmp_path / f"fuzz{case}.avro")
+        write_avro_file(p, schema, recs, codec=rng.choice(["null", "deflate"]))
+        sh = {"global": FeatureShardConfig(("features",))}
+        kw = dict(id_tag_columns=["k0"])
+        py, im = read_avro_dataset(p, sh, engine="python", **kw)
+        nat, im_n = read_avro_dataset(p, sh, engine="native", **kw)
+        try:
+            assert sorted(im_n["global"].keys()) == sorted(im["global"].keys())
+            _assert_dataset_equal(nat, py, sh)
+        except AssertionError as e:
+            n_mismatch += 1
+            print(f"case {case} mismatch: {e}\nschema: {schema}")
+    assert n_mismatch == 0
