@@ -134,14 +134,51 @@ class GameEstimator(EventEmitter):
                         f"coordinate {cc.name}: normalization is not supported "
                         "with the tiled layout (stats live in the unpadded space)"
                     )
+                if getattr(cc.config, "variance_type", "NONE") == "FULL":
+                    # fail at configuration time, not deep inside training
+                    # (parallel/sparse.py would otherwise raise mid-solve:
+                    # full-Hessian variances densify the tiled layout)
+                    raise ValueError(
+                        f"coordinate {cc.name}: variance=FULL is not supported "
+                        "with layout=tiled (the full Hessian would densify the "
+                        "sharded coefficient space); use variance=SIMPLE"
+                    )
 
     # -- dataset preparation -------------------------------------------------
 
     def _prepare_datasets(self, raw: RawDataset):
+        import jax
+
+        multiprocess = jax.process_count() > 1
+        if multiprocess and self.mesh is None:
+            raise ValueError(
+                "multi-process training requires a device mesh spanning all "
+                "global devices (pass mesh= to GameEstimator)"
+            )
         datasets = {}
         for cc in self.coordinate_configs:
             with timed(f"prepare dataset {cc.name}"):
                 if cc.is_random_effect:
+                    if multiprocess:
+                        # entity planning across hosts + device-side shuffle
+                        # (game/data_mp.py; the reference's partitioner+
+                        # partitionBy pipeline)
+                        from ..game.data_mp import build_random_effect_dataset_global
+
+                        ds = build_random_effect_dataset_global(
+                            raw,
+                            cc.name,
+                            cc.feature_shard,
+                            cc.random_effect_type,
+                            mesh=self.mesh,
+                            active_cap=cc.active_cap,
+                            active_lower_bound=cc.active_lower_bound,
+                            dtype=self.dtype,
+                            pad_entities_to_multiple=self.entity_pad_multiple,
+                            features_to_samples_ratio=cc.features_to_samples_ratio,
+                        )
+                        datasets[cc.name] = ds
+                        continue
                     ds = build_random_effect_dataset(
                         raw,
                         cc.name,
@@ -175,6 +212,10 @@ class GameEstimator(EventEmitter):
                         ds = dataclasses.replace(
                             ds, batch=shard_batch(ds.batch, self.mesh)
                         )
+                    if multiprocess:
+                        # multi-process sample space is the padded GLOBAL row
+                        # space: scores/residuals stay [N_global], no trimming
+                        ds = dataclasses.replace(ds, true_n_rows=ds.batch.n_rows)
                     datasets[cc.name] = ds
         return datasets
 
@@ -253,16 +294,33 @@ class GameEstimator(EventEmitter):
 
     # -- fit -------------------------------------------------------------------
 
+    def prepare_datasets(self, raw: RawDataset):
+        """Build per-coordinate datasets once; pass the result to ``fit`` via
+        ``datasets=`` to train several configurations (checkpointed grids,
+        tuning trials) without rebuilding."""
+        return self._prepare_datasets(raw)
+
     def fit(
         self,
         raw: RawDataset,
         validation: Optional[RawDataset] = None,
         initial_model: Optional[GameModel] = None,
         checkpoint_fn: Optional[object] = None,
+        datasets: Optional[Dict[str, object]] = None,
+        combos: Optional[Sequence[Mapping[str, float]]] = None,
+        n_cd_iterations: Optional[int] = None,
     ) -> List[GameResult]:
         """``checkpoint_fn(reg_weights, iteration, game_model)`` runs after
-        each completed coordinate-descent sweep of each configuration."""
-        datasets = self._prepare_datasets(raw)
+        each completed coordinate-descent sweep of each configuration.
+
+        ``datasets``: pre-built datasets from :meth:`prepare_datasets`.
+        ``combos``: explicit list of per-coordinate reg-weight dicts to train
+        instead of the full cartesian grid (checkpoint resume trains the
+        remaining combos one at a time). ``n_cd_iterations`` overrides the
+        estimator's sweep count for THIS call (resuming a partly-trained
+        configuration)."""
+        if datasets is None:
+            datasets = self._prepare_datasets(raw)
         validation_ctx = None
         if validation is not None:
             # evaluator_specs default to RMSE inside _validation_context
@@ -271,6 +329,13 @@ class GameEstimator(EventEmitter):
         # cartesian product of per-coordinate reg-weight grids
         grids = [cc.grid() for cc in self.coordinate_configs]
         names = [cc.name for cc in self.coordinate_configs]
+        if combos is None:
+            combos = [
+                dict(zip(names, combo)) for combo in itertools.product(*grids)
+            ]
+        n_iterations = (
+            self.n_cd_iterations if n_cd_iterations is None else n_cd_iterations
+        )
         results: List[GameResult] = []
         prev_models: Dict[str, object] = dict(
             (initial_model.models if initial_model else {})
@@ -278,8 +343,8 @@ class GameEstimator(EventEmitter):
         import time as _time
 
         self.send_event(TrainingStartEvent(time=_time.time()))
-        for combo in itertools.product(*grids):
-            reg_weights = dict(zip(names, combo))
+        for reg_weights in combos:
+            reg_weights = dict(reg_weights)
             coords = self._make_coordinates(datasets, reg_weights, prev_models)
             cd_ckpt = None
             if checkpoint_fn is not None:
@@ -288,7 +353,7 @@ class GameEstimator(EventEmitter):
                     _w, it, GameModel(models=models, task=task)
                 )
             cd = CoordinateDescent(
-                coords, n_iterations=self.n_cd_iterations,
+                coords, n_iterations=n_iterations,
                 validation=validation_ctx, checkpoint_fn=cd_ckpt,
             )
             with timed(f"train config {reg_weights}", logging.INFO):

@@ -177,22 +177,32 @@ class RandomEffectCoordinate(Coordinate):
         else:
             offsets = blocks.offsets
 
+        # w0/priors as host numpy: valid jit inputs in both single- and
+        # multi-process mode (multi-process: every process holds the full
+        # array; jit treats numpy inputs as replicated contributions)
+        np_dtype = np.dtype(jnp.zeros((), dtype).dtype)
         if initial_model is not None:
-            w0 = _initial_subspace_coefficients(self.dataset, initial_model, dtype)
+            w0 = np.asarray(
+                _initial_subspace_coefficients(self.dataset, initial_model, dtype)
+            )
         else:
-            w0 = jnp.zeros((E, S), dtype)
+            w0 = np.zeros((E, S), np_dtype)
 
-        prior_mean = jnp.zeros((E, S), dtype)
-        prior_prec = jnp.ones((E, S), dtype)
+        prior_mean = np.zeros((E, S), np_dtype)
+        prior_prec = np.ones((E, S), np_dtype)
         if self.prior_model is not None:
-            prior_mean = _project_model_values(
-                self.dataset, self.prior_model, self.prior_model.coef_values, dtype
+            prior_mean = np.asarray(
+                _project_model_values(
+                    self.dataset, self.prior_model, self.prior_model.coef_values, dtype
+                )
             )
             if self.prior_model.variances is not None:
-                var = _project_model_values(
-                    self.dataset, self.prior_model, self.prior_model.variances, dtype
+                var = np.asarray(
+                    _project_model_values(
+                        self.dataset, self.prior_model, self.prior_model.variances, dtype
+                    )
                 )
-                prior_prec = 1.0 / jnp.maximum(var, 1e-12)
+                prior_prec = 1.0 / np.maximum(var, 1e-12)
 
         cfg = self.config
         solver_cfg = cfg.solver_config()
@@ -234,14 +244,25 @@ class RandomEffectCoordinate(Coordinate):
                     )
                 )
             results = _concat_results(parts, S)
+        if jax.process_count() > 1:
+            # entity-sharded outputs span processes; replicate so every host
+            # can read the model (saving, validation scoring, trackers) — the
+            # reference's collect-model-to-driver step
+            from ..parallel import multihost
+
+            mesh = blocks.features.sharding.mesh
+            results = multihost.fully_replicate(results, mesh)
+            coef_indices = jnp.asarray(self.dataset.host_proj_cols)
+        else:
+            coef_indices = blocks.proj_cols
         w_sub = results.coefficients  # [E, S]
-        valid = blocks.proj_cols >= 0
+        valid = coef_indices >= 0
         model = RandomEffectModel(
             random_effect_type=self.dataset.random_effect_type,
             feature_shard=self.dataset.feature_shard,
             task=self.task,
             entity_ids=self.dataset.entity_ids,
-            coef_indices=blocks.proj_cols,
+            coef_indices=coef_indices,
             coef_values=jnp.where(valid, w_sub, 0.0),
         )
         return model, results
@@ -251,13 +272,17 @@ class RandomEffectCoordinate(Coordinate):
         # The model's entity-row order may differ from this dataset's block
         # order (warm start from a loaded model, locked partial-retrain
         # models): remap dataset block rows -> model rows by entity id.
+        # Device-side gather: works when row_entity is sharded across
+        # processes (multi-process) as well as single-host.
         ds_ids = list(map(str, self.dataset.entity_ids))
         m_ids = list(map(str, model.entity_ids))
         if ds_ids != m_ids:
             block_to_model = model.rows_for(self.dataset.entity_ids).astype(np.int32)
-            re_np = np.asarray(row_entity)
-            mapped = np.where(re_np >= 0, block_to_model[np.maximum(re_np, 0)], -1)
-            row_entity = jnp.asarray(mapped.astype(np.int32))
+            row_entity = jnp.where(
+                row_entity >= 0,
+                jnp.take(jnp.asarray(block_to_model), jnp.maximum(row_entity, 0)),
+                -1,
+            ).astype(jnp.int32)
         ds_dtype = self.dataset.ell_val.dtype
         if model.coef_values.dtype != ds_dtype:
             model = dataclasses.replace(
@@ -364,10 +389,15 @@ def _project_model_values(
     reference ModelProjection.scala:30-85)."""
     blocks = dataset.blocks
     E, S = blocks.proj_cols.shape
+    # multi-process: blocks.proj_cols is entity-sharded (not host-addressable);
+    # the dataset carries a host copy for layout checks and projection
+    pc_host = dataset.host_proj_cols
+    if pc_host is None:
+        pc_host = np.asarray(blocks.proj_cols)
     if (
         model.coef_indices.shape == (E, S)
         and model.num_entities == E
-        and np.array_equal(np.asarray(model.coef_indices), np.asarray(blocks.proj_cols))
+        and np.array_equal(np.asarray(model.coef_indices), pc_host)
         and list(map(str, model.entity_ids)) == list(map(str, dataset.entity_ids))
     ):
         return jnp.asarray(values, dtype)  # same layout: reuse directly
@@ -377,7 +407,7 @@ def _project_model_values(
     # laid-out checkpoint stays O(nnz log nnz) host time.
     dim = int(
         max(
-            int(np.asarray(blocks.proj_cols).max(initial=0)),
+            int(pc_host.max(initial=0)),
             int(np.asarray(model.coef_indices).max(initial=0)),
         )
         + 1
@@ -391,7 +421,7 @@ def _project_model_values(
     mvals_s = vals[me, ms][order]
 
     rows = np.asarray(model.rows_for(dataset.entity_ids))  # [E] model row or -1
-    pc = np.asarray(blocks.proj_cols)
+    pc = pc_host
     de, dsl = np.nonzero((pc >= 0) & (rows[:, None] >= 0))
     dkeys = rows[de].astype(np.int64) * dim + pc[de, dsl]
     w0 = np.zeros((E, S))

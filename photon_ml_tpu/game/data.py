@@ -115,10 +115,66 @@ class RandomEffectDataset:
     # size-aware partitioning, RandomEffectDatasetPartitioner.scala:117-180)
     entity_counts: Optional[np.ndarray] = None  # i64[E] active rows per entity
     entity_subspace_dims: Optional[np.ndarray] = None  # i64[E] real S per entity
+    # multi-process: host copy of blocks.proj_cols (the device array is
+    # entity-sharded across processes, so not host-addressable); model
+    # projection / warm-start layout checks read this instead
+    host_proj_cols: Optional[np.ndarray] = None
 
     @property
     def num_entities(self) -> int:
         return len(self.entity_ids)
+
+
+@dataclasses.dataclass(frozen=True)
+class _EntityPlan:
+    """The deterministic entity layout every process must agree on: which
+    entities train, their block order (size-sorted descending, stable), the
+    padded block count, the per-entity active cap, and weight rescales.
+    Computed from the (possibly cross-process-merged) per-entity counts alone,
+    so identical inputs give identical plans on every host."""
+
+    kept_entities: np.ndarray  # i64[E_real] indices into uniq, size-sorted
+    old_to_block: np.ndarray  # i64[len(uniq)] -> block row or -1
+    E_real: int
+    E: int  # padded block count
+    cap: int
+    K: int  # block row capacity
+    weight_scale: np.ndarray  # f8[E] count/cap rescale for capped entities
+
+
+def _entity_plan(
+    counts: np.ndarray,
+    active_lower_bound: int,
+    active_cap: Optional[int],
+    pad_entities_to_multiple: int,
+) -> _EntityPlan:
+    kept_mask = counts >= active_lower_bound
+    kept_entities = np.nonzero(kept_mask)[0]
+    # order entities by descending size: natural bin-packing order for sharding
+    kept_entities = kept_entities[np.argsort(-counts[kept_entities], kind="stable")]
+    E_real = len(kept_entities)
+    E = max(
+        ((E_real + pad_entities_to_multiple - 1) // pad_entities_to_multiple)
+        * pad_entities_to_multiple,
+        pad_entities_to_multiple,
+    )
+    old_to_block = np.full(len(counts), -1, dtype=np.int64)
+    old_to_block[kept_entities] = np.arange(E_real)
+    cap = active_cap if active_cap is not None else int(counts.max() if len(counts) else 1)
+    K = int(min(int(counts[kept_entities].max()) if E_real else 1, cap)) or 1
+    weight_scale = np.ones(E)
+    if E_real:
+        counts_kept = counts[kept_entities].astype(np.float64)
+        weight_scale[:E_real] = np.where(counts_kept > cap, counts_kept / cap, 1.0)
+    return _EntityPlan(
+        kept_entities=kept_entities,
+        old_to_block=old_to_block,
+        E_real=E_real,
+        E=E,
+        cap=cap,
+        K=K,
+        weight_scale=weight_scale,
+    )
 
 
 def _hash64(a: np.ndarray, seed: int) -> np.ndarray:
@@ -131,13 +187,16 @@ def _hash64(a: np.ndarray, seed: int) -> np.ndarray:
 
 
 def _rows_to_ell(
-    rows: np.ndarray, cols: np.ndarray, vals: np.ndarray, n: int
+    rows: np.ndarray, cols: np.ndarray, vals: np.ndarray, n: int,
+    width: Optional[int] = None,
 ) -> Tuple[np.ndarray, np.ndarray]:
-    """COO -> per-row padded (idx, val) with idx=0/val=0 padding. Vectorized."""
+    """COO -> per-row padded (idx, val) with idx=0/val=0 padding. Vectorized.
+    ``width`` overrides the ELL width (multi-process: the GLOBAL max row nnz,
+    so per-host shapes agree)."""
     order = np.lexsort((cols, rows))
     r, c, v = rows[order], cols[order], vals[order]
     counts = np.bincount(r, minlength=n)
-    F = max(int(counts.max()) if n else 1, 1)
+    F = width if width is not None else max(int(counts.max()) if n else 1, 1)
     idx = np.zeros((n, F), dtype=np.int32)
     val = np.zeros((n, F), dtype=np.float64)
     if len(r):
@@ -256,21 +315,11 @@ def build_random_effect_dataset(
     uniq, inv = np.unique(ids_arr, return_inverse=True)
     counts = np.bincount(inv, minlength=len(uniq))
 
-    kept_mask = counts >= active_lower_bound
-    kept_entities = np.nonzero(kept_mask)[0]
-    # order entities by descending size: natural bin-packing order for sharding
-    kept_entities = kept_entities[np.argsort(-counts[kept_entities], kind="stable")]
-    E_real = len(kept_entities)
-    E = max(
-        ((E_real + pad_entities_to_multiple - 1) // pad_entities_to_multiple)
-        * pad_entities_to_multiple,
-        pad_entities_to_multiple,
+    plan = _entity_plan(
+        counts, active_lower_bound, active_cap, pad_entities_to_multiple
     )
-    old_to_block = np.full(len(uniq), -1, dtype=np.int64)
-    old_to_block[kept_entities] = np.arange(E_real)
-
-    cap = active_cap if active_cap is not None else int(counts.max() if len(counts) else 1)
-    K = int(min(int(counts[kept_entities].max()) if E_real else 1, cap)) or 1
+    kept_entities, old_to_block = plan.kept_entities, plan.old_to_block
+    E_real, E, cap, K = plan.E_real, plan.E, plan.cap, plan.K
 
     # --- per-entity active selection (deterministic reservoir) ---------------
     row_ids = np.arange(n, dtype=np.int64)
@@ -291,10 +340,7 @@ def build_random_effect_dataset(
         is_active = np.zeros(n, dtype=bool)
 
     active_rows_np = np.full((E, K), -1, dtype=np.int64)
-    weight_scale = np.ones(E)
-    if E_real:
-        counts_kept = counts[kept_entities].astype(np.float64)
-        weight_scale[:E_real] = np.where(counts_kept > cap, counts_kept / cap, 1.0)
+    weight_scale = plan.weight_scale
     sel = np.nonzero(is_active)[0]
     active_rows_np[sorted_entity[sel], rank[sel]] = sorted_rows[sel]
 

@@ -629,6 +629,14 @@ def count_avro_rows(path: str) -> int:
             while not r.at_end():
                 count = r.read_long()
                 size = r.read_long()
+                # a corrupt/hostile header could rewind the cursor (negative
+                # size => infinite loop) or overflow the total; validate like
+                # the read path's block-skip does
+                if count < 0 or size < 0 or r.pos + size + SYNC_SIZE > len(data):
+                    raise ValueError(
+                        f"{path}: corrupt Avro block header "
+                        f"(count={count}, size={size} at offset {r.pos})"
+                    )
                 r.pos += size + SYNC_SIZE
                 total += count
             return total

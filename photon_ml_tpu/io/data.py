@@ -58,6 +58,11 @@ class RawDataset:
     shard_dims: Dict[str, int]
     id_tags: Dict[str, np.ndarray]  # tag -> object array of per-row ids
     uids: Optional[np.ndarray] = None
+    # multi-process provenance: this process's rows are global rows
+    # [global_row_start, global_row_start + true_rows); rows beyond true_rows
+    # are zero-weight equal-share padding (pad_rows)
+    global_row_start: Optional[int] = None
+    true_rows: Optional[int] = None
 
     def subset(self, rows: np.ndarray) -> "RawDataset":
         """Row-subset view (train/validation splits; host-side)."""
@@ -100,6 +105,8 @@ class RawDataset:
             uids=None
             if self.uids is None
             else np.concatenate([self.uids, np.full(extra, None, dtype=object)]),
+            global_row_start=self.global_row_start,
+            true_rows=self.n_rows if self.true_rows is None else self.true_rows,
         )
 
     def to_batch(self, shard: str, dtype=None, layout: str = "auto", mesh=None):
@@ -520,18 +527,26 @@ def _native_read(
             return np.empty(0)
         return np.concatenate([c.num_cols[sink] for c in cols])
 
-    # response: first non-NaN among the candidates, else 0.0
+    def stack_present(sink: int) -> np.ndarray:
+        if not cols:
+            return np.empty(0, bool)
+        return np.concatenate([c.num_present[sink] for c in cols])
+
+    # response: first PRESENT candidate, else 0.0 — presence (not NaN) is the
+    # absence test, so a genuine NaN label propagates exactly like the Python
+    # codec's
     labels = np.zeros(n, dtype=np.float64)
     filled = np.zeros(n, dtype=bool)
     for name in resp_candidates:
-        cand = stack_num(num_fields[name])
-        take = ~filled & ~np.isnan(cand)
+        sink = num_fields[name]
+        cand = stack_num(sink)
+        take = ~filled & stack_present(sink)
         labels[take] = cand[take]
         filled |= take
     offs = stack_num(off_sink)
-    offs[np.isnan(offs)] = 0.0
+    offs[~stack_present(off_sink)] = 0.0
     wts = stack_num(wt_sink)
-    wts[np.isnan(wts)] = 1.0
+    wts[~stack_present(wt_sink)] = 1.0
 
     def scatter_str(sink: int, default) -> np.ndarray:
         out = np.full(n, default, dtype=object)

@@ -56,12 +56,16 @@ def _build() -> Optional[ctypes.CDLL]:
                 not os.path.exists(_LIB_PATH)
                 or os.path.getmtime(_LIB_PATH) < os.path.getmtime(_SRC)
             ):
+                # per-pid temp name: concurrent first-use builds (multi-process
+                # CLI) must not interleave g++ output into one file before the
+                # atomic rename
+                tmp = f"{_LIB_PATH}.tmp.{os.getpid()}"
                 cmd = [
                     "g++", "-O3", "-Wall", "-shared", "-fPIC",
-                    _SRC, "-o", _LIB_PATH + ".tmp", "-lz",
+                    _SRC, "-o", tmp, "-lz",
                 ]
                 subprocess.run(cmd, check=True, capture_output=True, text=True)
-                os.replace(_LIB_PATH + ".tmp", _LIB_PATH)
+                os.replace(tmp, _LIB_PATH)
                 logger.info("built native decoder: %s", _LIB_PATH)
             lib = ctypes.CDLL(_LIB_PATH)
         except (OSError, subprocess.CalledProcessError) as e:
@@ -91,6 +95,8 @@ def _bind(lib: ctypes.CDLL):
     lib.pr_n_rows.argtypes = [c.c_void_p]
     lib.pr_num_col.restype = c.POINTER(c.c_double)
     lib.pr_num_col.argtypes = [c.c_void_p, c.c_int32]
+    lib.pr_num_present.restype = c.POINTER(c.c_uint8)
+    lib.pr_num_present.argtypes = [c.c_void_p, c.c_int32]
     for name in ("pr_str_count", "pr_bag_count", "pr_bag_n_keys"):
         fn = getattr(lib, name)
         fn.restype = c.c_int64
@@ -258,11 +264,12 @@ def compile_program(
 class Columnar:
     """Decoded columnar content of one file (numpy copies, C buffers freed)."""
 
-    __slots__ = ("n_rows", "num_cols", "str_cols", "bags")
+    __slots__ = ("n_rows", "num_cols", "num_present", "str_cols", "bags")
 
-    def __init__(self, n_rows, num_cols, str_cols, bags):
+    def __init__(self, n_rows, num_cols, num_present, str_cols, bags):
         self.n_rows = n_rows
         self.num_cols = num_cols      # [np.ndarray f8[n_rows]]
+        self.num_present = num_present  # [np.ndarray bool[n_rows]] field seen
         self.str_cols = str_cols      # [(rows i8[k], values object[k])]
         self.bags = bags              # [(rows i8[m], key_ids i4[m], vals f8[m], keys object[u])]
 
@@ -378,6 +385,13 @@ def _decode_mapped(lib, path, data, num_fields, str_fields, bag_fields,
             if n else np.empty(0)
             for s in range(n_num)
         ]
+        num_present = [
+            np.ctypeslib.as_array(lib.pr_num_present(res, s), shape=(n,))
+            .copy()
+            .astype(bool)
+            if n else np.empty(0, bool)
+            for s in range(n_num)
+        ]
         str_cols = []
         for s in range(n_str):
             k = lib.pr_str_count(res, s)
@@ -408,6 +422,6 @@ def _decode_mapped(lib, path, data, num_fields, str_fields, bag_fields,
             ).copy()
             raw = ctypes.string_at(lib.pr_bag_key_bytes(res, b), int(offs[-1]))
             bags.append((rows, kid, vals, _split_strings(offs, raw)))
-        return Columnar(int(n), num_cols, str_cols, bags)
+        return Columnar(int(n), num_cols, num_present, str_cols, bags)
     finally:
         lib.pr_free(res)

@@ -93,6 +93,10 @@ struct Bag {
 struct Result {
   int64_t n_rows = 0;
   std::vector<std::vector<double>> num_cols;  // [sink][row]
+  // presence bitmap per numeric sink: distinguishes an absent field from a
+  // present-but-NaN value (the Python codec propagates NaN; without this the
+  // two engines would disagree on rows carrying genuine NaNs)
+  std::vector<std::vector<uint8_t>> num_present;
   std::vector<StrPairs> str_cols;
   std::vector<Bag> bags;
   std::string error;
@@ -155,6 +159,9 @@ void store_num(Ctx& c, int32_t sink, double v) {
   auto& col = c.res->num_cols[sink];
   if ((int64_t)col.size() <= c.row) col.resize(c.row + 1, NAN);
   col[c.row] = v;
+  auto& pres = c.res->num_present[sink];
+  if ((int64_t)pres.size() <= c.row) pres.resize(c.row + 1, 0);
+  pres[c.row] = 1;
 }
 
 void store_str(Ctx& c, int32_t sink, const char* s, int64_t n) {
@@ -475,6 +482,7 @@ void* pr_decode(const uint8_t* data, int64_t file_len, int64_t data_off,
                 int32_t n_map_keys, int64_t row_start, int64_t row_stop) {
   auto* res = new Result();
   res->num_cols.resize(n_num);
+  res->num_present.resize(n_num);
   res->str_cols.resize(n_str);
   res->bags.resize(n_bags);
 
@@ -534,6 +542,7 @@ void* pr_decode(const uint8_t* data, int64_t file_len, int64_t data_off,
   }
   res->n_rows = out_row;
   for (auto& col : res->num_cols) col.resize((size_t)out_row, NAN);
+  for (auto& pres : res->num_present) pres.resize((size_t)out_row, 0);
   return res;
 }
 
@@ -542,6 +551,10 @@ int64_t pr_n_rows(void* r) { return ((Result*)r)->n_rows; }
 
 const double* pr_num_col(void* r, int32_t s) {
   return ((Result*)r)->num_cols[s].data();
+}
+
+const uint8_t* pr_num_present(void* r, int32_t s) {
+  return ((Result*)r)->num_present[s].data();
 }
 
 int64_t pr_str_count(void* r, int32_t s) {

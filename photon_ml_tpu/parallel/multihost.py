@@ -21,6 +21,7 @@ directly with::
 
 from __future__ import annotations
 
+import functools
 from typing import Optional, Tuple
 
 import jax
@@ -117,3 +118,61 @@ def equal_host_share(n_rows: int, count: Optional[int] = None) -> int:
     invisible to the objectives)."""
     p = process_count() if count is None else count
     return -(-n_rows // p)
+
+
+def allgather_object(obj):
+    """Gather one picklable object per process; returns the process-ordered
+    list on every process (single-process: ``[obj]``).
+
+    The payload rides the device collective fabric (ICI/DCN) via
+    ``multihost_utils.process_allgather`` — two rounds: sizes, then
+    max-size-padded uint8 payloads. Meant for *planning metadata* (entity
+    tables, shape agreements — the analogue of the reference collecting
+    (entityId -> count) to the driver, RandomEffectDatasetPartitioner.scala:
+    117-180), NOT for bulk row data, which stays in globally-sharded arrays.
+    """
+    if jax.process_count() == 1:
+        return [obj]
+    import pickle
+
+    from jax.experimental import multihost_utils
+
+    payload = np.frombuffer(pickle.dumps(obj), dtype=np.uint8)
+    sizes = multihost_utils.process_allgather(
+        np.asarray([payload.size], np.int64)
+    ).reshape(-1)
+    padded = np.zeros(int(sizes.max()), np.uint8)
+    padded[: payload.size] = payload
+    gathered = multihost_utils.process_allgather(padded)
+    return [
+        pickle.loads(gathered[i, : int(sizes[i])].tobytes())
+        for i in range(jax.process_count())
+    ]
+
+
+@functools.lru_cache(maxsize=32)
+def _replicate_fn(sharding: NamedSharding):
+    # cached per sharding: jit keys on function identity, so a fresh lambda
+    # per call would retrace/recompile the all-gather every invocation
+    return jax.jit(lambda t: t, out_shardings=sharding)
+
+
+def fully_replicate(tree, mesh: Mesh):
+    """Reshard a pytree of (possibly non-addressable, e.g. entity-sharded)
+    global arrays to fully-replicated — an XLA all-gather — so every process
+    can ``np.asarray`` the result (model saving, host-side trackers: the
+    reference's collect-model-to-driver step). Single-process: identity."""
+    if jax.process_count() == 1:
+        return tree
+    return _replicate_fn(NamedSharding(mesh, P()))(tree)
+
+
+def put_global_from_full(full: np.ndarray, mesh: Mesh, spec: P) -> jax.Array:
+    """Place an array every process holds IN FULL onto the mesh with `spec`
+    (each process contributes the shards its devices own). The complement of
+    ``put_global``, which takes per-process *local* blocks."""
+    sharding = NamedSharding(mesh, spec)
+    full = np.asarray(full)
+    if jax.process_count() == 1:
+        return jax.device_put(full, sharding)
+    return jax.make_array_from_callback(full.shape, sharding, lambda idx: full[idx])

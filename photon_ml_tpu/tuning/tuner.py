@@ -30,26 +30,33 @@ class HyperparameterTuner:
         observations: Optional[Sequence[Observation]] = None,
         discrete_params=None,
         seed: int = 0,
+        skip: int = 0,
     ) -> List[Observation]:
+        """``skip``: candidates already consumed by a previous (checkpointed)
+        run — deterministic tuners burn that many draws so a resumed search
+        continues the original candidate sequence instead of repeating it."""
         raise NotImplementedError
 
 
 class DummyTuner(HyperparameterTuner):
     """No-op tuner (DummyTuner.scala:39): returns no new observations."""
 
-    def search(self, n, dimension, evaluation_function, observations=None, discrete_params=None, seed=0):
+    def search(self, n, dimension, evaluation_function, observations=None, discrete_params=None, seed=0, skip=0):
         return []
 
 
 class RandomTuner(HyperparameterTuner):
-    def search(self, n, dimension, evaluation_function, observations=None, discrete_params=None, seed=0):
-        return RandomSearch(dimension, evaluation_function, discrete_params, seed).find(
-            n, observations=observations
-        )
+    def search(self, n, dimension, evaluation_function, observations=None, discrete_params=None, seed=0, skip=0):
+        search = RandomSearch(dimension, evaluation_function, discrete_params, seed)
+        if skip:
+            search.draw_candidates(skip)  # burn the consumed prefix
+        return search.find(n, observations=observations)
 
 
 class BayesianTuner(HyperparameterTuner):
-    def search(self, n, dimension, evaluation_function, observations=None, discrete_params=None, seed=0):
+    def search(self, n, dimension, evaluation_function, observations=None, discrete_params=None, seed=0, skip=0):
+        # GP candidates condition on the observation set (which includes any
+        # replayed trials), so no draws are burned on resume
         return GaussianProcessSearch(
             dimension, evaluation_function, discrete_params, seed=seed
         ).find(n, observations=observations)

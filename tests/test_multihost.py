@@ -291,3 +291,143 @@ def test_two_process_uneven_rows(tmp_path):
     assert any("reads rows [0, 161) of 321 (padded to 161)" in err for _, _, err in outs)
     assert any("reads rows [161, 321) of 321 (padded to 161)" in err for _, _, err in outs)
     assert os.path.exists(os.path.join(out_multi, "training-summary.json"))
+
+
+def _write_glmix_data(tmp_path, n=640, seed=21):
+    """Avro records with global + per-user feature bags and userId ids."""
+    from photon_ml_tpu.io import write_avro_file
+    from photon_ml_tpu.io.schemas import TRAINING_EXAMPLE_AVRO
+    from photon_ml_tpu.testing import (
+        generate_game_records,
+        generate_mixed_effect_data,
+    )
+
+    data = generate_mixed_effect_data(
+        n=n, d_fixed=5, re_specs={"userId": (12, 3)}, seed=seed
+    )
+    recs = generate_game_records(data)
+    schema = {
+        **TRAINING_EXAMPLE_AVRO,
+        "fields": TRAINING_EXAMPLE_AVRO["fields"]
+        + [
+            {
+                "name": "userFeatures",
+                "type": {"type": "array", "items": "FeatureAvro"},
+                "default": [],
+            }
+        ],
+    }
+    p = str(tmp_path / "glmix.avro")
+    write_avro_file(p, schema, recs)
+    return p
+
+
+@pytest.mark.slow
+def test_two_process_glmix_matches_single_process(tmp_path):
+    """THE cluster test: GLMix (fixed + per-user random effect) trained across
+    2 processes — per-host row reads, cross-host entity planning, device-side
+    shuffle, entity-sharded solves — must match the single-process model.
+    (Reference: RandomEffectCoordinate.scala:273-329 trains entities across
+    executors; this is the TPU-native equivalent.)"""
+    data = _write_glmix_data(tmp_path)
+    index_dir = str(tmp_path / "index")
+    out_multi = str(tmp_path / "multi")
+    out_single = str(tmp_path / "single")
+
+    from photon_ml_tpu.cli import index as index_cli
+
+    common = [
+        "--input-data", data,
+        "--feature-shard", "name=globalShard,bags=features",
+        "--feature-shard", "name=userShard,bags=userFeatures",
+    ]
+    index_cli.run(common + ["--output-dir", index_dir])
+
+    train_common = common + [
+        "--validation-data", data,
+        "--task", "logistic_regression",
+        "--coordinate",
+        "name=global,shard=globalShard,optimizer=LBFGS,tolerance=1e-12,"
+        "max.iter=300,reg.type=L2,reg.weights=1",
+        "--coordinate",
+        "name=per-user,shard=userShard,re.type=userId,optimizer=LBFGS,"
+        "tolerance=1e-12,max.iter=300,reg.type=L2,reg.weights=1",
+        "--coordinate-descent-iterations", "2",
+        "--evaluators", "AUC,LOGISTIC_LOSS",
+        "--feature-index-dir", index_dir,
+    ]
+
+    port = _free_port()
+    env = {**os.environ, "PYTHONPATH": REPO}
+    env.pop("XLA_FLAGS", None)
+    procs = [
+        subprocess.Popen(
+            [
+                sys.executable, "-c", _WORKER.split("# exact-math parity")[0],
+                *train_common,
+                "--output-dir", out_multi,
+                "--mesh-shape", "data=8",
+                "--distributed", f"coordinator=localhost:{port},process={i},n=2",
+            ],
+            env=env, cwd=REPO,
+            stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True,
+        )
+        for i in range(2)
+    ]
+    outs = []
+    for p in procs:
+        try:
+            out, err = p.communicate(timeout=540)
+        except subprocess.TimeoutExpired:
+            for q in procs:
+                q.kill()
+            pytest.fail("multi-process GLMix training timed out")
+        outs.append((p.returncode, out, err))
+    for rc, out, err in outs:
+        assert rc == 0, f"worker failed:\n{out}\n{err}"
+        assert "WORKER_OK" in out
+
+    from photon_ml_tpu.cli import train as train_cli
+
+    train_cli.run(train_common + ["--output-dir", out_single, "--mesh-shape", "data=8"])
+
+    with open(os.path.join(out_multi, "training-summary.json")) as f:
+        multi = json.load(f)
+    with open(os.path.join(out_single, "training-summary.json")) as f:
+        single = json.load(f)
+    assert multi["best"]["metrics"]["AUC"] == pytest.approx(
+        single["best"]["metrics"]["AUC"], abs=2e-3
+    )
+    assert multi["best"]["metrics"]["LOGISTIC_LOSS"] == pytest.approx(
+        single["best"]["metrics"]["LOGISTIC_LOSS"], rel=1e-3
+    )
+
+    from photon_ml_tpu.io.index_map import load_partitioned
+    from photon_ml_tpu.io.model_io import load_game_model
+
+    imaps = {s: load_partitioned(index_dir, s) for s in ("globalShard", "userShard")}
+    m_multi = load_game_model(
+        os.path.join(out_multi, "models", "best"), imaps, task="logistic_regression"
+    )
+    m_single = load_game_model(
+        os.path.join(out_single, "models", "best"), imaps, task="logistic_regression"
+    )
+    w_multi = np.asarray(m_multi.models["global"].coefficients.means)
+    w_single = np.asarray(m_single.models["global"].coefficients.means)
+    np.testing.assert_allclose(w_multi, w_single, rtol=1e-2, atol=1e-3)
+
+    re_m, re_s = m_multi.models["per-user"], m_single.models["per-user"]
+    # compare per-entity coefficient vectors keyed by entity id (block order
+    # may legally differ between the two builds)
+    dim = max(
+        int(np.asarray(re_m.coef_indices).max()), int(np.asarray(re_s.coef_indices).max())
+    ) + 1
+    dense_m = re_m.dense_coefficients(dim)
+    dense_s = re_s.dense_coefficients(dim)
+    ids_s = [str(e) for e in re_s.entity_ids if not str(e).startswith("__pad")]
+    rows_m = re_m.rows_for(ids_s)
+    rows_s = re_s.rows_for(ids_s)
+    assert np.all(rows_m >= 0), "multi-process model is missing entities"
+    np.testing.assert_allclose(
+        dense_m[rows_m], dense_s[rows_s], rtol=1e-2, atol=2e-3
+    )

@@ -415,3 +415,35 @@ def test_native_randomized_schema_parity(tmp_path):
             n_mismatch += 1
             print(f"case {case} mismatch: {e}\nschema: {schema}")
     assert n_mismatch == 0
+
+
+def test_native_nan_values_match_python_engine(tmp_path):
+    """Present-but-NaN label/offset/weight must decode identically on both
+    engines: the native decoder reports field PRESENCE explicitly, so a
+    genuine NaN propagates instead of collapsing to the absent-field default
+    (round-3 advisor finding)."""
+    from photon_ml_tpu.io import FeatureShardConfig, read_avro_dataset, write_avro_file
+    from photon_ml_tpu.io.schemas import TRAINING_EXAMPLE_AVRO
+
+    nan = float("nan")
+    recs = [
+        {"label": nan, "features": [{"name": "a", "term": "", "value": 1.0}]},
+        {"label": 1.0, "offset": nan,
+         "features": [{"name": "a", "term": "", "value": 2.0}]},
+        {"label": 0.0, "weight": nan,
+         "features": [{"name": "a", "term": "", "value": 3.0}]},
+        # absent numeric fields still get the defaults
+        {"label": 1.0, "features": [{"name": "a", "term": "", "value": 4.0}]},
+    ]
+    p = str(tmp_path / "nan.avro")
+    write_avro_file(p, TRAINING_EXAMPLE_AVRO, recs)
+    sh = {"g": FeatureShardConfig(("features",))}
+    py, _ = read_avro_dataset(p, sh, engine="python")
+    nat, _ = read_avro_dataset(p, sh, engine="native")
+    np.testing.assert_array_equal(py.labels, nat.labels)
+    np.testing.assert_array_equal(py.offsets, nat.offsets)
+    np.testing.assert_array_equal(py.weights, nat.weights)
+    assert np.isnan(nat.labels[0])
+    assert np.isnan(nat.offsets[1])
+    assert np.isnan(nat.weights[2])
+    assert nat.offsets[3] == 0.0 and nat.weights[3] == 1.0

@@ -178,3 +178,135 @@ def test_size_bucketed_solve_equals_single_block():
     np.testing.assert_array_equal(
         np.asarray(r_bucketed.iterations), np.asarray(r_flat.iterations)
     )
+
+
+class TestGlobalBuildParity:
+    """game/data_mp.build_random_effect_dataset_global run single-process must
+    reproduce the host numpy build bit-for-bit: the multi-process path's
+    planning (entity order, reservoir, subspace projection) is the same
+    algorithm re-expressed as a device sort/gather pipeline, and this parity
+    is what certifies it before the 2-process test exercises the exchange."""
+
+    def _raw(self, n=700, seed=5, n_entities=60, d_re=9):
+        return mixed_data_to_raw_dataset(
+            generate_mixed_effect_data(
+                n=n, d_fixed=4, re_specs={"userId": (n_entities, d_re)},
+                seed=seed, entity_skew=1.4,
+            )
+        )
+
+    @pytest.mark.parametrize(
+        "cap,lower", [(None, 1), (6, 1), (None, 3), (4, 2)]
+    )
+    def test_exact_parity(self, cap, lower):
+        from photon_ml_tpu.game.data_mp import build_random_effect_dataset_global
+        from photon_ml_tpu.parallel.mesh import make_mesh
+
+        raw = self._raw()
+        kw = dict(
+            active_cap=cap, active_lower_bound=lower, pad_entities_to_multiple=8
+        )
+        a = build_random_effect_dataset(raw, "re", "userShard", "userId", **kw)
+        b = build_random_effect_dataset_global(
+            raw, "re", "userShard", "userId", mesh=make_mesh(n_data=8), **kw
+        )
+        n = raw.n_rows
+        assert list(a.entity_ids) == list(b.entity_ids)
+        np.testing.assert_array_equal(
+            np.asarray(a.blocks.active_rows), np.asarray(b.blocks.active_rows)
+        )
+        np.testing.assert_array_equal(
+            np.asarray(a.blocks.proj_cols), np.asarray(b.blocks.proj_cols)
+        )
+        np.testing.assert_array_equal(b.host_proj_cols, np.asarray(b.blocks.proj_cols))
+        for f in ("features", "labels", "offsets", "weights"):
+            np.testing.assert_allclose(
+                np.asarray(getattr(a.blocks, f)),
+                np.asarray(getattr(b.blocks, f)),
+                rtol=1e-6,
+                err_msg=f,
+            )
+        # b's row space is padded to the mesh row multiple; pad rows map to no
+        # entity
+        np.testing.assert_array_equal(
+            np.asarray(a.row_entity), np.asarray(b.row_entity)[:n]
+        )
+        assert np.all(np.asarray(b.row_entity)[n:] == -1)
+        F = a.ell_idx.shape[1]
+        np.testing.assert_array_equal(
+            np.asarray(a.ell_idx), np.asarray(b.ell_idx)[:n, :F]
+        )
+        np.testing.assert_allclose(
+            np.asarray(a.ell_val), np.asarray(b.ell_val)[:n, :F], rtol=1e-6
+        )
+        np.testing.assert_array_equal(a.entity_counts, b.entity_counts)
+        np.testing.assert_array_equal(
+            a.entity_subspace_dims, b.entity_subspace_dims
+        )
+
+    def test_pearson_selection_agrees(self):
+        """Pearson selection: counts must match exactly; the kept COLUMNS may
+        differ only where scores tie exactly (host/device summation order
+        breaks exact ties differently — see data_mp docstring)."""
+        from photon_ml_tpu.game.data_mp import build_random_effect_dataset_global
+        from photon_ml_tpu.parallel.mesh import make_mesh
+
+        raw = self._raw(n=900, seed=9)
+        kw = dict(
+            active_cap=8, pad_entities_to_multiple=8, features_to_samples_ratio=0.5
+        )
+        a = build_random_effect_dataset(raw, "re", "userShard", "userId", **kw)
+        b = build_random_effect_dataset_global(
+            raw, "re", "userShard", "userId", mesh=make_mesh(n_data=8), **kw
+        )
+        np.testing.assert_array_equal(
+            a.entity_subspace_dims, b.entity_subspace_dims
+        )
+        pa, pb = np.asarray(a.blocks.proj_cols), np.asarray(b.blocks.proj_cols)
+        agree = (pa == pb).mean()
+        assert agree > 0.9, f"kept-column agreement {agree:.3f}"
+
+    def test_training_on_global_build_matches(self):
+        """A full RE coordinate train on the device-built dataset equals the
+        numpy-built one (same blocks => same solves)."""
+        import dataclasses as dc
+
+        from photon_ml_tpu.game import (
+            GLMOptimizationConfig,
+            RandomEffectCoordinate,
+        )
+        from photon_ml_tpu.game.data_mp import build_random_effect_dataset_global
+        from photon_ml_tpu.ops.regularization import RegularizationContext
+        from photon_ml_tpu.optimize import OptimizerConfig
+        from photon_ml_tpu.parallel.mesh import make_mesh
+
+        raw = self._raw(n=800, seed=13)
+        mesh = make_mesh(n_data=8)
+        kw = dict(active_cap=32, pad_entities_to_multiple=8)
+        a = build_random_effect_dataset(raw, "re", "userShard", "userId", **kw)
+        b = build_random_effect_dataset_global(
+            raw, "re", "userShard", "userId", mesh=mesh, **kw
+        )
+        cfg = GLMOptimizationConfig(
+            optimizer=OptimizerConfig(tolerance=1e-10, max_iterations=50),
+            regularization=RegularizationContext("L2"),
+            reg_weight=0.7,
+        )
+        ma, ra = RandomEffectCoordinate(
+            dataset=a, task="logistic_regression", config=cfg
+        ).train(None)
+        mb, rb = RandomEffectCoordinate(
+            dataset=b, task="logistic_regression", config=cfg
+        ).train(None)
+        np.testing.assert_allclose(
+            np.asarray(ma.coef_values), np.asarray(mb.coef_values), atol=1e-10
+        )
+        # scoring through the padded global row space matches on true rows
+        sa = np.asarray(RandomEffectCoordinate(
+            dataset=a, task="logistic_regression", config=cfg
+        ).score(ma))
+        sb = np.asarray(RandomEffectCoordinate(
+            dataset=b, task="logistic_regression", config=cfg
+        ).score(mb))
+        np.testing.assert_allclose(sa, sb[: raw.n_rows], atol=1e-10)
+        assert np.all(sb[raw.n_rows:] == 0.0)
