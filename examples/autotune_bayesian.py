@@ -19,6 +19,10 @@ import time
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
+from photon_ml_tpu.utils.compile_cache import enable_persistent_compilation_cache
+
+enable_persistent_compilation_cache()
+
 HEART = "/root/reference/photon-client/src/integTest/resources/DriverIntegTest/input/heart.avro"
 
 

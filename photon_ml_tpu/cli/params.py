@@ -184,3 +184,24 @@ def build_shard_configs(args) -> Dict[str, FeatureShardConfig]:
     if not shards:
         shards["global"] = FeatureShardConfig(feature_bags=("features",))
     return shards
+
+
+def plan_host_row_split(input_paths):
+    """Multi-process input planning shared by the train/score drivers:
+    count rows per part file (block headers only) and split the global row
+    space evenly across processes. Returns (row_range, part_counts), or
+    (None, None) when single-process."""
+    from ..parallel import multihost
+
+    if multihost.process_count() <= 1:
+        return None, None
+    from ..io.avro import count_avro_rows, list_avro_parts
+
+    paths = [input_paths] if isinstance(input_paths, str) else input_paths
+    part_counts = {
+        part: count_avro_rows(part)
+        for p in paths
+        for part in list_avro_parts(p)
+    }
+    row_range = multihost.host_row_range(sum(part_counts.values()))
+    return row_range, part_counts

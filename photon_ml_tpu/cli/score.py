@@ -23,7 +23,13 @@ from ..io.index_map import load_partitioned
 from ..io.model_io import load_game_model
 from ..io.schemas import SCORING_RESULT_AVRO
 from ..utils.logging import setup_logging
-from .params import parse_input_columns, resolve_input_paths, add_common_io_args, build_shard_configs
+from .params import (
+    add_common_io_args,
+    build_shard_configs,
+    parse_input_columns,
+    plan_host_row_split,
+    resolve_input_paths,
+)
 
 logger = logging.getLogger("photon_ml_tpu")
 
@@ -36,6 +42,13 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--task", default=None, help="override model task type")
     p.add_argument("--evaluators", default="")
     p.add_argument("--model-id", default="", help="modelId stamped on score records")
+    p.add_argument(
+        "--distributed",
+        default=None,
+        help="multi-host: 'coordinator=HOST:PORT,process=I,n=P' (or 'auto'); "
+        "each process scores its own row range and writes its own part file; "
+        "evaluation metrics are computed globally on process 0",
+    )
     p.add_argument("--log-file", default=None)
     p.add_argument("--log-level", default="INFO")
     return p
@@ -45,19 +58,49 @@ def run(argv: Optional[List[str]] = None):
     args = build_parser().parse_args(argv)
     setup_logging(args.log_level, args.log_file)
 
+    from ..utils.compile_cache import enable_persistent_compilation_cache
+
+    enable_persistent_compilation_cache()
+
+    from ..parallel import multihost
+
+    if args.distributed:
+        if args.distributed == "auto":
+            multihost.initialize()
+        else:
+            multihost.initialize_from_spec(args.distributed)
+
     shards = build_shard_configs(args)
     id_tags = [t for t in args.id_tags.split(",") if t]
 
     index_maps = None
     if args.feature_index_dir:
         index_maps = {s: load_partitioned(args.feature_index_dir, s) for s in shards}
+    input_paths = resolve_input_paths(args)
+
+    # distributed scoring is embarrassingly parallel (GameScoringDriver.scala:
+    # 25-284 scores per executor partition): each process reads and scores its
+    # own row range — no cross-host exchange until evaluation
+    if multihost.process_count() > 1 and index_maps is None:
+        raise SystemExit(
+            "multi-process scoring requires --feature-index-dir "
+            "(host-local index maps would disagree across hosts)"
+        )
+    row_range, part_counts = plan_host_row_split(input_paths)
+    if row_range is not None:
+        logger.info(
+            "process %d scores rows [%d, %d)",
+            multihost.process_index(), row_range[0], row_range[1],
+        )
     raw, index_maps = read_avro_dataset(
-        resolve_input_paths(args),
+        input_paths,
         shards,
         index_maps=index_maps,
         id_tag_columns=id_tags,
         response_column=args.response_column,
         columns=parse_input_columns(args),
+        row_range=row_range,
+        part_counts=part_counts,
     )
     model = load_game_model(args.model_input_dir, index_maps, task=args.task)
     # random-effect types must be available as id tags
@@ -73,7 +116,30 @@ def run(argv: Optional[List[str]] = None):
 
     transformer = GameTransformer(model=model)
     evaluators = [e for e in args.evaluators.split(",") if e]
-    scores, evaluation = transformer.transform(raw, evaluator_specs=evaluators)
+    multiprocess = multihost.process_count() > 1
+    # multi-process: score locally, evaluate globally below
+    scores, evaluation = transformer.transform(
+        raw, evaluator_specs=() if multiprocess else evaluators
+    )
+
+    if multiprocess and evaluators:
+        # global metrics need every host's (score, label, weight, tags):
+        # allgather the scored columns — bytes-per-row, not features — and
+        # evaluate the full set identically on every process
+        parts = multihost.allgather_object(
+            (scores, raw.labels, raw.weights,
+             {t: raw.id_tags[t] for t in raw.id_tags})
+        )
+        all_scores = np.concatenate([p[0] for p in parts])
+        all_labels = np.concatenate([p[1] for p in parts])
+        all_weights = np.concatenate([p[2] for p in parts])
+        all_tags = {
+            t: np.concatenate([p[3][t] for p in parts]) for t in raw.id_tags
+        }
+        from ..evaluation.suite import build_suite
+
+        suite = build_suite(evaluators, all_labels, all_weights, id_tags=all_tags)
+        evaluation = suite.evaluate(all_scores)
 
     os.makedirs(args.output_dir, exist_ok=True)
 
@@ -88,10 +154,15 @@ def run(argv: Optional[List[str]] = None):
                 "metadataMap": None,
             }
 
-    write_avro_file(
-        os.path.join(args.output_dir, "scores.avro"), SCORING_RESULT_AVRO, records()
+    part_name = (
+        f"scores-part-{multihost.process_index():04d}.avro"
+        if multiprocess
+        else "scores.avro"
     )
-    if evaluation is not None:
+    write_avro_file(
+        os.path.join(args.output_dir, part_name), SCORING_RESULT_AVRO, records()
+    )
+    if evaluation is not None and multihost.is_coordinator():
         with open(os.path.join(args.output_dir, "evaluation.json"), "w") as f:
             json.dump(evaluation.metrics, f, indent=2, default=float)
         logger.info("evaluation: %s", evaluation.metrics)

@@ -43,6 +43,7 @@ from .params import (
     parse_coordinate,
     parse_input_columns,
     parse_mesh_shape,
+    plan_host_row_split,
     resolve_input_paths,
 )
 
@@ -66,6 +67,14 @@ def build_parser() -> argparse.ArgumentParser:
         help="coordinate configuration spec (repeatable, ordered)",
     )
     p.add_argument("--coordinate-descent-iterations", type=int, default=1)
+    p.add_argument(
+        "--validation-frequency",
+        default="COORDINATE",
+        choices=["COORDINATE", "SWEEP"],
+        help="evaluate validation metrics after every coordinate update "
+        "(reference semantics) or once per sweep (1/n_coordinates of the "
+        "metric cost on long sweeps)",
+    )
     p.add_argument("--evaluators", default="", help="comma-separated evaluator specs")
     p.add_argument("--output-dir", required=True)
     p.add_argument(
@@ -148,6 +157,10 @@ def run(argv: Optional[List[str]] = None) -> Dict:
     args = build_parser().parse_args(argv)
     setup_logging(args.log_level, args.log_file)
 
+    from ..utils.compile_cache import enable_persistent_compilation_cache
+
+    enable_persistent_compilation_cache()
+
 
     if args.distributed:
         if args.distributed == "auto":
@@ -191,36 +204,13 @@ def run(argv: Optional[List[str]] = None) -> Dict:
     equal_share = None
     part_counts = None
     if multihost.process_count() > 1:
-        if any(getattr(cc, "layout", None) == "tiled" for cc in coords):
-            raise SystemExit(
-                "layout=tiled (model-axis sharding) is single-process only; "
-                "multi-process runs shard the data axis"
-            )
         if index_maps is None:
             raise SystemExit(
                 "multi-process training requires --feature-index-dir "
                 "(host-local index maps would disagree across hosts)"
             )
-        if args.normalization != "NONE":
-            raise SystemExit(
-                "multi-process training does not support --normalization yet "
-                "(statistics would be computed from host-local rows only)"
-            )
-        if args.compute_feature_stats:
-            raise SystemExit(
-                "--compute-feature-stats is single-process only (it would "
-                "summarize the coordinator's row slice as if it were global)"
-            )
-        from ..io.avro import count_avro_rows, list_avro_parts
-
-        paths = [input_paths] if isinstance(input_paths, str) else input_paths
-        part_counts = {
-            part: count_avro_rows(part)
-            for p in paths
-            for part in list_avro_parts(p)
-        }
+        row_range, part_counts = plan_host_row_split(input_paths)
         total_rows = sum(part_counts.values())
-        row_range = multihost.host_row_range(total_rows)
         # all hosts pad their slice to a common size so every process
         # contributes equal local shapes to the global arrays
         equal_share = multihost.equal_host_share(total_rows)
@@ -269,14 +259,19 @@ def run(argv: Optional[List[str]] = None) -> Dict:
                     intercept_index=index_maps[cc.feature_shard].intercept_index,
                 )
 
-    if args.compute_feature_stats and multihost.is_coordinator():
-        os.makedirs(args.output_dir, exist_ok=True)
+    if args.compute_feature_stats:
         for shard in shards:
-            save_feature_statistics(
-                os.path.join(args.output_dir, f"feature-stats-{shard}.avro"),
-                compute_feature_statistics(raw, shard),
-                index_maps[shard],
-            )
+            # the statistics reduce is a COLLECTIVE (cross-host allgather of
+            # moment sums): every process must participate; only the
+            # coordinator writes
+            stats = compute_feature_statistics(raw, shard)
+            if multihost.is_coordinator():
+                os.makedirs(args.output_dir, exist_ok=True)
+                save_feature_statistics(
+                    os.path.join(args.output_dir, f"feature-stats-{shard}.avro"),
+                    stats,
+                    index_maps[shard],
+                )
 
     initial_model = None
     if args.model_input_dir:
@@ -299,6 +294,7 @@ def run(argv: Optional[List[str]] = None) -> Dict:
             c for c in args.partial_retrain_locked.split(",") if c
         ],
         mesh=mesh,
+        validation_frequency=args.validation_frequency,
     )
     ckpt = None
     # datasets are reg-weight-independent: build once, lazily (an idempotent
@@ -411,6 +407,7 @@ def _run_tuning(args, estimator, raw, validation, coords, prior_results,
             evaluator_specs=[e for e in args.evaluators.split(",") if e],
             partial_retrain_locked=list(estimator.partial_retrain_locked),
             mesh=estimator.mesh,
+            validation_frequency=estimator.validation_frequency,
         )
         r = est.fit(
             raw, validation=validation,

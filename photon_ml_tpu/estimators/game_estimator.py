@@ -99,6 +99,7 @@ class GameEstimator(EventEmitter):
         partial_retrain_locked: Sequence[str] = (),
         entity_pad_multiple: int = 1,
         mesh=None,
+        validation_frequency: str = "COORDINATE",
     ):
         super().__init__()
         if not coordinate_configs:
@@ -113,6 +114,7 @@ class GameEstimator(EventEmitter):
         self.dtype = dtype
         self.partial_retrain_locked = set(partial_retrain_locked)
         self.mesh = mesh
+        self.validation_frequency = validation_frequency
         if mesh is not None and entity_pad_multiple == 1:
             # entity blocks shard over the data axis: pad to its size
             from ..parallel.mesh import DATA_AXIS
@@ -355,6 +357,7 @@ class GameEstimator(EventEmitter):
             cd = CoordinateDescent(
                 coords, n_iterations=n_iterations,
                 validation=validation_ctx, checkpoint_fn=cd_ckpt,
+                validation_frequency=self.validation_frequency,
             )
             with timed(f"train config {reg_weights}", logging.INFO):
                 out = cd.run(initial_models=prev_models)

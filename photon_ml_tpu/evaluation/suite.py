@@ -48,6 +48,23 @@ class EvaluationSuite:
     def primary(self) -> Evaluator:
         return self.evaluators[0]
 
+    def evaluate_device(self, scores) -> Optional[EvaluationResults]:
+        """Compute all metrics in ONE jitted device call (scores stay on
+        device; a single scalar-vector fetch crosses the host boundary).
+        Returns None when any evaluator needs the host path (grouped or
+        ranking metrics) — callers fall back to :meth:`evaluate`."""
+        if not hasattr(self, "_device_eval"):
+            from .device import build_device_evaluator
+
+            self._device_eval = build_device_evaluator(
+                self.evaluators, self.labels, self.weights
+            )
+        if self._device_eval is None:
+            return None
+        return EvaluationResults(
+            primary_name=self.primary.name, metrics=self._device_eval(scores)
+        )
+
     def evaluate(self, scores) -> EvaluationResults:
         scores = np.asarray(scores, dtype=np.float64)
         out: Dict[str, float] = {}

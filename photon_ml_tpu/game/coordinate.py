@@ -104,6 +104,18 @@ class FixedEffectCoordinate(Coordinate):
         glm, result = problem.run(
             batch, initial_model=initial_model.model if initial_model else None
         )
+        if jax.process_count() > 1:
+            # tiled solves leave coefficients model-axis-sharded across
+            # processes; replicate so every host can read/save the model
+            from ..parallel import multihost
+
+            mesh = getattr(batch.features, "mesh", None)
+            if mesh is not None:
+                glm = dataclasses.replace(
+                    glm,
+                    coefficients=multihost.fully_replicate(glm.coefficients, mesh),
+                )
+                result = multihost.fully_replicate(result, mesh)
         # models live in the shard's TRUE feature space: trim any mesh padding
         d_true = self.dataset.dim
         if glm.coefficients.means.shape[0] > d_true:
@@ -125,12 +137,21 @@ class FixedEffectCoordinate(Coordinate):
         feats = self.dataset.batch.features
         # compute in the dataset's dtype: a warm-start model loaded under an
         # x64 config is f64 and must not promote the f32 score/residual stream
-        means = jnp.asarray(
-            model.model.coefficients.means, self.dataset.batch.labels.dtype
-        )
+        dtype = self.dataset.batch.labels.dtype
+        means = jnp.asarray(model.model.coefficients.means, dtype)
         d_pad = feats.dim - means.shape[0]
         if d_pad > 0:
             means = jnp.concatenate([means, jnp.zeros((d_pad,), means.dtype)])
+        mesh = getattr(feats, "mesh", None)
+        if mesh is not None and jax.process_count() > 1:
+            # tiled matvec shard_maps over the model axis: reshard the vector
+            # on device (no host round trip — the d-sized fetch would cost
+            # seconds at huge d)
+            from jax.sharding import PartitionSpec
+            from ..parallel import multihost
+            from ..parallel.sparse import MODEL_AXIS
+
+            means = multihost.reshard(means, mesh, PartitionSpec(MODEL_AXIS))
         scores = feats.matvec(means)
         n_true = self.dataset.n_rows
         return scores[:n_true] if scores.shape[0] > n_true else scores

@@ -58,11 +58,18 @@ class CoordinateDescent:
         n_iterations: int = 1,
         validation: Optional[ValidationContext] = None,
         checkpoint_fn: Optional[object] = None,
+        validation_frequency: str = "COORDINATE",
     ):
         """``checkpoint_fn(iteration, models)`` runs after each completed
         sweep (crash recovery for long runs: resume = warm-start from the
         checkpointed models with the remaining iterations; the score state
-        reconstructs exactly from the models)."""
+        reconstructs exactly from the models).
+
+        ``validation_frequency``: 'COORDINATE' evaluates after every
+        coordinate update (reference semantics, CoordinateDescent.scala:
+        312-333); 'SWEEP' evaluates once per full sweep — same best-model
+        tracking at 1/n_coordinates of the metric cost (round-4 verdict
+        item 5: per-update host metrics dominate large sweeps)."""
         if not coordinates:
             raise ValueError("CoordinateDescent needs at least one coordinate")
         if n_iterations < 1:
@@ -70,11 +77,17 @@ class CoordinateDescent:
         # checkInvariants (CoordinateDescent.scala:71-92): locked coordinates
         # must not be retrained; with a single coordinate multiple iterations
         # are pointless (reference logs a warning).
+        if validation_frequency not in ("COORDINATE", "SWEEP"):
+            raise ValueError(
+                f"validation_frequency must be COORDINATE or SWEEP: "
+                f"{validation_frequency!r}"
+            )
         self.coordinates = dict(coordinates)
         self.order = list(coordinates)
         self.n_iterations = n_iterations
         self.validation = validation
         self.checkpoint_fn = checkpoint_fn
+        self.validation_frequency = validation_frequency
         n_trainable = sum(
             0 if isinstance(c, ModelCoordinate) else 1 for c in self.coordinates.values()
         )
@@ -141,23 +154,17 @@ class CoordinateDescent:
                 summed = residual + new_scores
                 scores[name] = new_scores
 
-                if self.validation is not None:
-                    res = self._evaluate(models)
-                    evaluations.append((name, res))
-                    primary = self.validation.suite.primary
-                    # only snapshots with every coordinate trained are
-                    # candidates for "best model" — a mid-first-sweep partial
-                    # model is not a valid GAME model
-                    complete = len(models) == len(self.order)
-                    if complete and (
-                        best_eval is None
-                        or primary.better(res.primary_metric, best_eval.primary_metric)
-                    ):
-                        best_eval = res
-                        best_models = dict(models)
-                    logger.info(
-                        "cd iter %d coordinate %s: %s", it, name, res.metrics
+                if (
+                    self.validation is not None
+                    and self.validation_frequency == "COORDINATE"
+                ):
+                    best_eval, best_models = self._track_best(
+                        models, evaluations, best_eval, best_models, it, name
                     )
+            if self.validation is not None and self.validation_frequency == "SWEEP":
+                best_eval, best_models = self._track_best(
+                    models, evaluations, best_eval, best_models, it, self.order[-1]
+                )
             if self.checkpoint_fn is not None:
                 self.checkpoint_fn(it, dict(models))
 
@@ -170,6 +177,23 @@ class CoordinateDescent:
             trackers=trackers,
         )
 
+    def _track_best(self, models, evaluations, best_eval, best_models, it, name):
+        res = self._evaluate(models)
+        evaluations.append((name, res))
+        primary = self.validation.suite.primary
+        # only snapshots with every coordinate trained are candidates for
+        # "best model" — a mid-first-sweep partial model is not a valid GAME
+        # model
+        complete = len(models) == len(self.order)
+        if complete and (
+            best_eval is None
+            or primary.better(res.primary_metric, best_eval.primary_metric)
+        ):
+            best_eval = res
+            best_models = dict(models)
+        logger.info("cd iter %d coordinate %s: %s", it, name, res.metrics)
+        return best_eval, best_models
+
     def _infer_task(self) -> str:
         """Task from the coordinate definitions (every trainable coordinate
         carries it; locked ModelCoordinates delegate to their inner)."""
@@ -181,8 +205,11 @@ class CoordinateDescent:
         return "linear_regression"
 
     def _evaluate(self, models: Mapping[str, object]) -> EvaluationResults:
-        """Accumulate per-coordinate validation scores on device; a single
-        host transfer feeds the (host-side) metric evaluators."""
+        """Accumulate per-coordinate validation scores on device and, when
+        every metric has a device implementation, evaluate there too — one
+        scalar fetch per update instead of a score-vector transfer plus host
+        sorts (evaluation/device.py). Grouped/ranking metrics fall back to
+        the host path."""
         v = self.validation
         acc = None
         for name, model in models.items():
@@ -190,6 +217,11 @@ class CoordinateDescent:
             if fn is not None:
                 s = fn(model)
                 acc = s if acc is None else acc + s
+        if acc is not None:
+            total_dev = acc + jnp.asarray(v.offsets, acc.dtype)
+            res = v.suite.evaluate_device(total_dev)
+            if res is not None:
+                return res
         total = np.asarray(v.offsets, dtype=np.float64)
         if acc is not None:
             total = total + np.asarray(acc, dtype=np.float64)

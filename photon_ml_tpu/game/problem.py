@@ -16,6 +16,7 @@ from typing import Optional, Tuple
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 
 from ..models.coefficients import Coefficients
 from ..models.glm import GeneralizedLinearModel, model_for_task
@@ -124,10 +125,13 @@ class GLMProblem:
             # tiled batch: shard the coefficient vector over the model axis so
             # every solver state array ([m, d] L-BFGS history included)
             # inherits the partition instead of replicating d on one device
+            # (multi-process safe, no host round trip: every process built the
+            # same w0, the jitted reshard places it)
+            from jax.sharding import PartitionSpec
+            from ..parallel.multihost import reshard
             from ..parallel.sparse import MODEL_AXIS
-            from jax.sharding import NamedSharding, PartitionSpec
 
-            w0 = jax.device_put(w0, NamedSharding(mesh, PartitionSpec(MODEL_AXIS)))
+            w0 = reshard(jnp.asarray(w0, dtype), mesh, PartitionSpec(MODEL_AXIS))
 
         from ..ops.glm import hvp_fn, vg_fn
 

@@ -509,11 +509,13 @@ def _native_read(
     )
     bag_fields = {b: i for i, b in enumerate(all_bags)}
 
-    # decode every part (respecting the global row window)
+    # decode every part (respecting the global row window); each part decodes
+    # its OCF blocks on a thread pool (native.decode_file_chunks) — the
+    # chunk Columnars stitch exactly like per-file parts
     cols: List[native.Columnar] = []
     for part, window in _iter_part_windows(paths, row_range, part_counts):
-        cols.append(
-            native.decode_file(
+        cols.extend(
+            native.decode_file_chunks(
                 part, num_fields, str_fields, bag_fields, map_keys,
                 map_field=col_names[META_DATA_MAP], row_range=window,
             )

@@ -157,6 +157,14 @@ def _replicate_fn(sharding: NamedSharding):
     return jax.jit(lambda t: t, out_shardings=sharding)
 
 
+def reshard(tree, mesh: Mesh, spec: P):
+    """Device-side reshard via a cached jitted identity — no host round trip
+    (multi-process: inputs may be process-local/uncommitted arrays holding
+    identical values on every host, e.g. a freshly built coefficient vector;
+    the jit places them under `spec` with collectives as needed)."""
+    return _replicate_fn(NamedSharding(mesh, spec))(tree)
+
+
 def fully_replicate(tree, mesh: Mesh):
     """Reshard a pytree of (possibly non-addressable, e.g. entity-sharded)
     global arrays to fully-replicated — an XLA all-gather — so every process

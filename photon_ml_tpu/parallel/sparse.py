@@ -151,24 +151,44 @@ def tile_sparse_matrix(
     """Host-side one-time tiling (the analogue of the reference's dataset
     partitioning shuffle, SURVEY.md P13). Pads n and d to mesh multiples and
     each tile's nnz to the max tile size.
+
+    Multi-process: ``rows``/``n_rows`` are this process's LOCAL row slice.
+    Each process owns a contiguous block of the data axis with every model
+    column, so tiles build locally from local COO — the only cross-host
+    agreement is the max tile size (one scalar allgather). The global row
+    space is the concatenation of the per-process padded slices, matching
+    the padded global sample space of the other coordinates.
     """
+    from . import multihost
+
     D = mesh.shape[DATA_AXIS]
     M = mesh.shape[MODEL_AXIS]
-    n_pad = max(((n_rows + D - 1) // D) * D, D)
+    n_proc = jax.process_count()
+    if D % n_proc != 0:
+        raise ValueError(
+            f"tiled layout: data axis ({D}) must divide evenly across "
+            f"{n_proc} processes"
+        )
+    D_local = D // n_proc
+    # pad LOCAL rows to the local share of the data axis; the global padded
+    # row count is the sum of the (equal) per-process shares
+    n_loc_rows = max(((n_rows + D_local - 1) // D_local) * D_local, D_local)
+    n_pad = n_loc_rows * n_proc
     d_pad = max(((dim + M - 1) // M) * M, M)
-    n_loc, d_loc = n_pad // D, d_pad // M
+    n_loc, d_loc = n_loc_rows // D_local, d_pad // M
 
     tile_r = rows // n_loc
     tile_c = cols // d_loc
     key = tile_r * M + tile_c
     order = np.lexsort((cols, key))
     r_s, c_s, v_s, k_s = rows[order], cols[order], vals[order], key[order]
-    counts = np.bincount(k_s, minlength=D * M)
-    m_tile = max(int(counts.max()) if len(counts) else 0, 1)
+    counts = np.bincount(k_s, minlength=D_local * M)
+    m_local = max(int(counts.max()) if len(counts) else 0, 1)
+    m_tile = max(t for t in multihost.allgather_object(m_local))
 
-    lcol = np.full((D * M, m_tile), d_loc - 1, dtype=np.int32)
-    lrow = np.zeros((D * M, m_tile), dtype=np.int32)
-    lval = np.zeros((D * M, m_tile), dtype=np.float64)
+    lcol = np.full((D_local * M, m_tile), d_loc - 1, dtype=np.int32)
+    lrow = np.zeros((D_local * M, m_tile), dtype=np.int32)
+    lval = np.zeros((D_local * M, m_tile), dtype=np.float64)
     if len(k_s):
         starts = np.cumsum(np.concatenate([[0], counts[:-1]]))
         within = np.arange(len(k_s)) - starts[k_s]
@@ -176,14 +196,15 @@ def tile_sparse_matrix(
         lrow[k_s, within] = r_s % n_loc
         lval[k_s, within] = v_s
 
-    spec = NamedSharding(mesh, P(DATA_AXIS, MODEL_AXIS, None))
+    spec = P(DATA_AXIS, MODEL_AXIS, None)
+    put = lambda a: multihost.put_global(a, mesh, spec)
     return TiledSparseMatrix(
         dim=d_pad,
         n_rows=n_pad,
         mesh=mesh,
-        lcol=jax.device_put(lcol.reshape(D, M, m_tile), spec),
-        lrow=jax.device_put(lrow.reshape(D, M, m_tile), spec),
-        lval=jax.device_put(lval.reshape(D, M, m_tile).astype(np.dtype(dtype)), spec),
+        lcol=put(lcol.reshape(D_local, M, m_tile)),
+        lrow=put(lrow.reshape(D_local, M, m_tile)),
+        lval=put(lval.reshape(D_local, M, m_tile).astype(np.dtype(dtype))),
     )
 
 
@@ -201,15 +222,18 @@ def tiled_sparse_batch(
     """Build a LabeledBatch whose features are mesh-tiled; labels/offsets/
     weights are zero-padded to the mesh row multiple and sharded over the
     data axis (padded rows carry weight 0)."""
+    from . import multihost
+
     n = len(y)
     feats = tile_sparse_matrix(rows, cols, vals, n, dim, mesh, dtype=dtype)
-    n_pad = feats.n_rows
+    # per-process local share of the padded global row space
+    n_loc_pad = feats.n_rows // jax.process_count()
 
     def pad1(a, fill=0.0):
-        out = np.full(n_pad, fill, dtype=np.float64)
+        out = np.full(n_loc_pad, fill, dtype=np.float64)
         out[:n] = a
-        return jax.device_put(
-            jnp.asarray(out, dtype), NamedSharding(mesh, P(DATA_AXIS))
+        return multihost.put_global(
+            np.asarray(out, np.dtype(dtype)), mesh, P(DATA_AXIS)
         )
 
     return LabeledBatch(
@@ -221,5 +245,11 @@ def tiled_sparse_batch(
 
 
 def replicated_coefficients(w: np.ndarray, mesh: Mesh, dtype=jnp.float32) -> Array:
-    """Place a [dim]-padded coefficient vector sharded over the model axis."""
-    return jax.device_put(jnp.asarray(w, dtype), NamedSharding(mesh, P(MODEL_AXIS)))
+    """Place a [dim]-padded coefficient vector sharded over the model axis
+    (multi-process: every process passes the full host vector and contributes
+    its devices' slices)."""
+    from . import multihost
+
+    return multihost.put_global_from_full(
+        np.asarray(w, np.dtype(dtype)), mesh, P(MODEL_AXIS)
+    )

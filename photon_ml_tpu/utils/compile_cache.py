@@ -1,0 +1,41 @@
+"""Persistent XLA compilation cache.
+
+GLMix cold starts are compile-bound: CD iteration 0 pays one fresh
+LBFGS/TRON compile per (K, S) entity-block bucket plus the fixed-effect
+solves (round-3 measurement: 245s first sweep vs 3.2s steady state on the
+3-coordinate example). The JAX persistent compilation cache survives
+processes — measured through the axon remote tunnel: an 86s first-call
+optimize() drops to 15s on the next process with the cache warm (5.8x).
+
+Enabled by default from the CLI drivers/bench; set PHOTON_COMPILE_CACHE to
+relocate it or PHOTON_COMPILE_CACHE=0 to disable.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+
+logger = logging.getLogger("photon_ml_tpu")
+
+
+def enable_persistent_compilation_cache(path: str | None = None) -> str | None:
+    """Best-effort: point jax at an on-disk compilation cache. Returns the
+    cache dir, or None when disabled/unavailable."""
+    env = os.environ.get("PHOTON_COMPILE_CACHE")
+    if env == "0":
+        return None
+    path = path or env or os.path.join(
+        os.path.expanduser("~"), ".cache", "photon-ml-tpu-xla"
+    )
+    try:
+        import jax
+
+        os.makedirs(path, exist_ok=True)
+        jax.config.update("jax_compilation_cache_dir", path)
+        # only persist compiles worth the disk round trip
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+    except Exception as e:  # never fail a run over a cache
+        logger.info("persistent compilation cache unavailable: %s", e)
+        return None
+    return path

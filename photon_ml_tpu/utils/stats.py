@@ -21,21 +21,43 @@ from ..io.schemas import FEATURE_SUMMARIZATION_RESULT_AVRO
 
 def compute_feature_statistics(raw: RawDataset, shard: str) -> Dict[str, np.ndarray]:
     """Weighted-count statistics over a shard's COO features (zeros included
-    in mean/variance via implicit zero entries, matching a dense summary)."""
+    in mean/variance via implicit zero entries, matching a dense summary).
+
+    Multi-process: each host computes moment sums over ITS row slice and the
+    d-sized sums are allgathered and combined, so every host returns the
+    GLOBAL statistics (the reference computes summaries over the full
+    DataFrame, GameTrainingDriver.scala:555-612 — here the cross-host reduce
+    is the d-vector exchange, not a row shuffle)."""
     rows, cols, vals = raw.shard_coo[shard]
     d = raw.shard_dims[shard]
-    n = raw.n_rows
+    # padded rows (multi-process equal-share) carry no features and must not
+    # inflate the count denominator
+    n = raw.true_rows if raw.true_rows is not None else raw.n_rows
     s1 = np.zeros(d)
     s2 = np.zeros(d)
     np.add.at(s1, cols, vals)
     np.add.at(s2, cols, vals * vals)
     nnz = np.bincount(cols, minlength=d).astype(np.float64)
-    mean = s1 / max(n, 1)
-    var = np.maximum(s2 / max(n, 1) - mean**2, 0.0)
     fmin = np.zeros(d)
     fmax = np.zeros(d)
     np.minimum.at(fmin, cols, vals)
     np.maximum.at(fmax, cols, vals)
+
+    import jax
+
+    if jax.process_count() > 1:
+        from ..parallel import multihost
+
+        parts = multihost.allgather_object((s1, s2, nnz, fmin, fmax, n))
+        s1 = np.sum([p[0] for p in parts], axis=0)
+        s2 = np.sum([p[1] for p in parts], axis=0)
+        nnz = np.sum([p[2] for p in parts], axis=0)
+        fmin = np.min([p[3] for p in parts], axis=0)
+        fmax = np.max([p[4] for p in parts], axis=0)
+        n = sum(p[5] for p in parts)
+
+    mean = s1 / max(n, 1)
+    var = np.maximum(s2 / max(n, 1) - mean**2, 0.0)
     max_mag = np.maximum(np.abs(fmin), np.abs(fmax))
     return {
         "mean": mean,

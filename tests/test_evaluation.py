@@ -1,6 +1,7 @@
 """Evaluator tests vs sklearn and hand-computed values (mirrors the
 reference's evaluation unit suites, incl. tie and weight handling)."""
 
+import jax.numpy as jnp
 import numpy as np
 import pytest
 
@@ -109,3 +110,58 @@ def test_suite(rng):
     assert res.primary_name == "AUC"
     assert set(res.metrics) == {"AUC", "RMSE", "AUC:userId"}
     assert 0.5 < res.metrics["AUC"] <= 1.0
+
+
+class TestDeviceMetrics:
+    """evaluation/device.py: jitted metrics must match the host evaluators
+    (incl. weighted tie handling in AUC) to float32 tolerance."""
+
+    def _data(self, seed=0, n=4000, with_ties=True):
+        rng = np.random.default_rng(seed)
+        s = rng.normal(size=n)
+        if with_ties:
+            s = np.round(s, 1)  # heavy score ties exercise the tie groups
+        y = (rng.uniform(size=n) < 1 / (1 + np.exp(-s))).astype(np.float64)
+        w = rng.uniform(0.5, 2.0, size=n)
+        return s, y, w
+
+    @pytest.mark.parametrize(
+        "name",
+        ["AUC", "RMSE", "LOGISTIC_LOSS", "POISSON_LOSS", "SQUARED_LOSS",
+         "SMOOTHED_HINGE_LOSS"],
+    )
+    def test_parity_with_host(self, name):
+        from photon_ml_tpu.evaluation import device as dev
+        from photon_ml_tpu.evaluation.evaluators import build_evaluator
+
+        s, y, w = self._data()
+        host = build_evaluator(name).evaluate(s, y, w)
+        got = float(dev.DEVICE_METRICS[name](
+            jnp.asarray(s, jnp.float32), jnp.asarray(y, jnp.float32),
+            jnp.asarray(w, jnp.float32),
+        ))
+        assert got == pytest.approx(host, rel=2e-4), name
+
+    def test_single_class_auc_nan(self):
+        from photon_ml_tpu.evaluation import device as dev
+
+        s = jnp.asarray([0.1, 0.2, 0.3])
+        one = jnp.ones(3)
+        assert np.isnan(float(dev.auc(s, one, one)))
+
+    def test_suite_device_path(self):
+        from photon_ml_tpu.evaluation.suite import build_suite
+
+        s, y, w = self._data(seed=3)
+        suite = build_suite(["AUC", "LOGISTIC_LOSS"], y, w)
+        host = suite.evaluate(s)
+        devr = suite.evaluate_device(jnp.asarray(s, jnp.float32))
+        assert devr is not None
+        for k in host.metrics:
+            assert devr.metrics[k] == pytest.approx(host.metrics[k], rel=2e-4)
+        # grouped metrics refuse the device path
+        ids = np.asarray(["a", "b"] * (len(s) // 2), dtype=object)
+        gsuite = build_suite(
+            ["AUC", "AUC:userId"], y, w, id_tags={"userId": ids}
+        )
+        assert gsuite.evaluate_device(jnp.asarray(s, jnp.float32)) is None

@@ -431,3 +431,250 @@ def test_two_process_glmix_matches_single_process(tmp_path):
     np.testing.assert_allclose(
         dense_m[rows_m], dense_s[rows_s], rtol=1e-2, atol=2e-3
     )
+
+
+_SCORE_WORKER = """
+import sys
+import jax
+jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_num_cpu_devices", 4)
+jax.config.update("jax_enable_x64", True)
+
+from photon_ml_tpu.cli import score
+
+score.run(sys.argv[1:])
+print("SCORE_OK", jax.process_index())
+"""
+
+
+@pytest.mark.slow
+def test_two_process_normalization_stats_and_scoring(tmp_path):
+    """Round-4 verdict item 6: multi-process normalization (global moment
+    sums), --compute-feature-stats (global summaries, process-0 writes), and
+    a distributed scoring driver (per-host row ranges, part files, global
+    metrics) must all match their single-process runs."""
+    data = _write_data(tmp_path, n=320)
+    index_dir = str(tmp_path / "index")
+    out_multi = str(tmp_path / "multi")
+    out_single = str(tmp_path / "single")
+
+    from photon_ml_tpu.cli import index as index_cli
+
+    common = [
+        "--input-data", data,
+        "--feature-shard", "name=global,bags=features",
+    ]
+    index_cli.run(common + ["--output-dir", index_dir])
+
+    train_common = common + [
+        "--validation-data", data,
+        "--task", "logistic_regression",
+        "--coordinate",
+        "name=global,shard=global,optimizer=LBFGS,tolerance=1e-12,max.iter=300,"
+        "reg.type=L2,reg.weights=1",
+        "--evaluators", "AUC,LOGISTIC_LOSS",
+        "--feature-index-dir", index_dir,
+        "--normalization", "STANDARDIZATION",
+        "--compute-feature-stats",
+    ]
+
+    port = _free_port()
+    env = {**os.environ, "PYTHONPATH": REPO}
+    env.pop("XLA_FLAGS", None)
+    procs = [
+        subprocess.Popen(
+            [
+                sys.executable, "-c", _WORKER.split("# exact-math parity")[0],
+                *train_common,
+                "--output-dir", out_multi,
+                "--mesh-shape", "data=8",
+                "--distributed", f"coordinator=localhost:{port},process={i},n=2",
+            ],
+            env=env, cwd=REPO,
+            stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True,
+        )
+        for i in range(2)
+    ]
+    outs = []
+    for p in procs:
+        try:
+            out, err = p.communicate(timeout=420)
+        except subprocess.TimeoutExpired:
+            for q in procs:
+                q.kill()
+            pytest.fail("multi-process normalized training timed out")
+        outs.append((p.returncode, out, err))
+    for rc, out, err in outs:
+        assert rc == 0, f"worker failed:\n{out}\n{err}"
+        assert "WORKER_OK" in out
+
+    from photon_ml_tpu.cli import train as train_cli
+
+    train_cli.run(train_common + ["--output-dir", out_single])
+
+    # normalized training matches single-process
+    with open(os.path.join(out_multi, "training-summary.json")) as f:
+        multi = json.load(f)
+    with open(os.path.join(out_single, "training-summary.json")) as f:
+        single = json.load(f)
+    assert multi["best"]["metrics"]["LOGISTIC_LOSS"] == pytest.approx(
+        single["best"]["metrics"]["LOGISTIC_LOSS"], rel=1e-4
+    )
+
+    # feature statistics written by process 0 are the GLOBAL statistics
+    from photon_ml_tpu.io import read_avro_file
+
+    _, recs_m = read_avro_file(os.path.join(out_multi, "feature-stats-global.avro"))
+    _, recs_s = read_avro_file(os.path.join(out_single, "feature-stats-global.avro"))
+    sm = {(r["featureName"], r["featureTerm"]): r["metrics"] for r in recs_m}
+    ss = {(r["featureName"], r["featureTerm"]): r["metrics"] for r in recs_s}
+    assert sm.keys() == ss.keys() and len(sm) > 0
+    for k in sm:
+        for metric in ("mean", "variance", "numNonzeros"):
+            assert sm[k][metric] == pytest.approx(ss[k][metric], rel=1e-12), (k, metric)
+
+    # distributed scoring: per-host part files + global metrics
+    score_multi = str(tmp_path / "score-multi")
+    score_single = str(tmp_path / "score-single")
+    score_common = common + [
+        "--feature-index-dir", index_dir,
+        "--model-input-dir", os.path.join(out_multi, "models", "best"),
+        "--task", "logistic_regression",
+        "--evaluators", "AUC",
+    ]
+    port2 = _free_port()
+    procs = [
+        subprocess.Popen(
+            [
+                sys.executable, "-c", _SCORE_WORKER,
+                *score_common,
+                "--output-dir", score_multi,
+                "--distributed", f"coordinator=localhost:{port2},process={i},n=2",
+            ],
+            env=env, cwd=REPO,
+            stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True,
+        )
+        for i in range(2)
+    ]
+    outs = []
+    for p in procs:
+        try:
+            out, err = p.communicate(timeout=420)
+        except subprocess.TimeoutExpired:
+            for q in procs:
+                q.kill()
+            pytest.fail("multi-process scoring timed out")
+        outs.append((p.returncode, out, err))
+    for rc, out, err in outs:
+        assert rc == 0, f"score worker failed:\n{out}\n{err}"
+        assert "SCORE_OK" in out
+
+    from photon_ml_tpu.cli import score as score_cli
+
+    score_cli.run(score_common + ["--output-dir", score_single])
+
+    _, single_recs = read_avro_file(os.path.join(score_single, "scores.avro"))
+    multi_recs = []
+    for i in range(2):
+        _, part = read_avro_file(
+            os.path.join(score_multi, f"scores-part-{i:04d}.avro")
+        )
+        multi_recs.extend(part)
+    assert len(multi_recs) == len(single_recs) == 320
+    s_single = np.asarray([r["predictionScore"] for r in single_recs])
+    s_multi = np.asarray([r["predictionScore"] for r in multi_recs])
+    np.testing.assert_allclose(s_multi, s_single, rtol=1e-6)
+
+    with open(os.path.join(score_multi, "evaluation.json")) as f:
+        ev_m = json.load(f)
+    with open(os.path.join(score_single, "evaluation.json")) as f:
+        ev_s = json.load(f)
+    assert ev_m["AUC"] == pytest.approx(ev_s["AUC"], abs=1e-12)
+
+
+@pytest.mark.slow
+def test_two_process_tiled_matches_single_process(tmp_path):
+    """Round-4 verdict item 8: layout=tiled (model-axis coefficient sharding)
+    across 2 processes — each host builds tiles for its own data-axis rows;
+    only the tile-size agreement crosses hosts — must match single-process."""
+    data = _write_data(tmp_path, n=320, d=10)
+    index_dir = str(tmp_path / "index")
+    out_multi = str(tmp_path / "multi")
+    out_single = str(tmp_path / "single")
+
+    from photon_ml_tpu.cli import index as index_cli
+
+    common = ["--input-data", data, "--feature-shard", "name=global,bags=features"]
+    index_cli.run(common + ["--output-dir", index_dir])
+
+    train_common = common + [
+        "--validation-data", data,
+        "--task", "logistic_regression",
+        "--coordinate",
+        "name=global,shard=global,layout=tiled,optimizer=LBFGS,tolerance=1e-12,"
+        "max.iter=300,reg.type=L2,reg.weights=1",
+        "--evaluators", "AUC,LOGISTIC_LOSS",
+        "--feature-index-dir", index_dir,
+    ]
+
+    port = _free_port()
+    env = {**os.environ, "PYTHONPATH": REPO}
+    env.pop("XLA_FLAGS", None)
+    procs = [
+        subprocess.Popen(
+            [
+                sys.executable, "-c", _WORKER.split("# exact-math parity")[0],
+                *train_common,
+                "--output-dir", out_multi,
+                "--mesh-shape", "data=4,model=2",
+                "--distributed", f"coordinator=localhost:{port},process={i},n=2",
+            ],
+            env=env, cwd=REPO,
+            stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True,
+        )
+        for i in range(2)
+    ]
+    outs = []
+    for p in procs:
+        try:
+            out, err = p.communicate(timeout=420)
+        except subprocess.TimeoutExpired:
+            for q in procs:
+                q.kill()
+            pytest.fail("multi-process tiled training timed out")
+        outs.append((p.returncode, out, err))
+    for rc, out, err in outs:
+        assert rc == 0, f"worker failed:\n{out}\n{err}"
+        assert "WORKER_OK" in out
+
+    from photon_ml_tpu.cli import train as train_cli
+
+    train_cli.run(
+        train_common + ["--output-dir", out_single, "--mesh-shape", "data=4,model=2"]
+    )
+
+    with open(os.path.join(out_multi, "training-summary.json")) as f:
+        multi = json.load(f)
+    with open(os.path.join(out_single, "training-summary.json")) as f:
+        single = json.load(f)
+    assert multi["best"]["metrics"]["LOGISTIC_LOSS"] == pytest.approx(
+        single["best"]["metrics"]["LOGISTIC_LOSS"], rel=1e-4
+    )
+
+    from photon_ml_tpu.io.index_map import load_partitioned
+    from photon_ml_tpu.io.model_io import load_game_model
+
+    imaps = {"global": load_partitioned(index_dir, "global")}
+    w_m = np.asarray(
+        load_game_model(
+            os.path.join(out_multi, "models", "best"), imaps,
+            task="logistic_regression",
+        ).models["global"].coefficients.means
+    )
+    w_s = np.asarray(
+        load_game_model(
+            os.path.join(out_single, "models", "best"), imaps,
+            task="logistic_regression",
+        ).models["global"].coefficients.means
+    )
+    np.testing.assert_allclose(w_m, w_s, rtol=1e-2, atol=1e-3)
