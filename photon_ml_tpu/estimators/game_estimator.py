@@ -136,15 +136,10 @@ class GameEstimator(EventEmitter):
                         f"coordinate {cc.name}: normalization is not supported "
                         "with the tiled layout (stats live in the unpadded space)"
                     )
-                if getattr(cc.config, "variance_type", "NONE") == "FULL":
-                    # fail at configuration time, not deep inside training
-                    # (parallel/sparse.py would otherwise raise mid-solve:
-                    # full-Hessian variances densify the tiled layout)
-                    raise ValueError(
-                        f"coordinate {cc.name}: variance=FULL is not supported "
-                        "with layout=tiled (the full Hessian would densify the "
-                        "sharded coefficient space); use variance=SIMPLE"
-                    )
+                # variance=FULL is supported on tiled via the chunked sharded
+                # X^T diag(c) X path (parallel/sparse.py xtcx) up to
+                # ops.glm.MAX_FULL_VARIANCE_DIM; the dim ceiling is checked at
+                # train time when d is known
 
     # -- dataset preparation -------------------------------------------------
 

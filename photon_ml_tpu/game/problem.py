@@ -30,6 +30,34 @@ from ..optimize import OptimizerConfig, SolverResult, optimize
 Array = jax.Array
 
 
+def _fusion_mode(batch: LabeledBatch) -> Optional[str]:
+    """Decide whether this batch takes the single-sweep Pallas kernels
+    (ops/pallas_glm.py): dense layout, eligible shapes/dtype, concretely
+    placed on ONE device. GSPMD-sharded batches keep the jnp two-pass path —
+    a pallas_call has no partitioning rule, so XLA would all-gather the
+    sharded X around it."""
+    from ..ops import pallas_glm
+
+    mode = pallas_glm.mode()
+    if mode == "off":
+        return None
+    f = batch.features
+    if not f.is_dense:
+        return None
+    x = f.dense
+    if isinstance(x, jax.core.Tracer):
+        return None
+    n, d = x.shape
+    if not pallas_glm.eligible(n, d, x.dtype):
+        return None
+    sharding = getattr(x, "sharding", None)
+    if sharding is not None and len(getattr(sharding, "device_set", ())) > 1:
+        return None
+    if mode == "interpret":
+        return "interpret"
+    return "compiled" if jax.default_backend() == "tpu" else None
+
+
 def _pad_dim(v: Array, dim: int, fill: float) -> Array:
     """Zero/one-pad a [d] vector up to a mesh-padded feature dim."""
     if v.shape[0] >= dim:
@@ -73,7 +101,9 @@ class GLMProblem:
     # its precisions (README.md:102-103 "Regularize by Previous Model")
     prior: Optional[Coefficients] = None
 
-    def objective(self, batch: LabeledBatch) -> GLMObjective:
+    def objective(
+        self, batch: LabeledBatch, fused: Optional[str] = None
+    ) -> GLMObjective:
         prior_mean = prior_precision = None
         if self.prior is not None:
             dtype = batch.labels.dtype
@@ -97,6 +127,7 @@ class GLMProblem:
             norm=self.normalization,
             prior_mean=prior_mean,
             prior_precision=prior_precision,
+            fused=fused,
         )
 
     def run(
@@ -111,7 +142,31 @@ class GLMProblem:
         mapped to the transformed space, optimization runs there, the final
         coefficients map back.
         """
-        obj = self.objective(batch)
+        if (
+            getattr(batch.features, "layout", None) == "tiled"
+            and self.config.variance_type.upper() == "FULL"
+        ):
+            # fail BEFORE the (possibly hours-long) solve, not after it
+            from ..ops.glm import MAX_FULL_VARIANCE_DIM
+
+            if batch.dim > MAX_FULL_VARIANCE_DIM:
+                raise ValueError(
+                    f"variance=FULL on the tiled layout needs a [d, d] Hessian "
+                    f"inverse; d={batch.dim} exceeds the supported ceiling "
+                    f"{MAX_FULL_VARIANCE_DIM} — use variance=SIMPLE"
+                )
+        fused = _fusion_mode(batch)
+        if fused is not None:
+            # pad rows once (weight 0) to the kernel's row-tile multiple; the
+            # feature dim is untouched, so models/variances need no trimming
+            from ..ops.pallas_glm import tile_rows
+            from ..ops.features import pad_batch
+
+            tn = tile_rows(batch.dim)
+            target = ((batch.n_rows + tn - 1) // tn) * tn
+            if target != batch.n_rows:
+                batch = pad_batch(batch, target)
+        obj = self.objective(batch, fused=fused)
         dtype = batch.labels.dtype
         if initial_model is not None:
             w0 = jnp.asarray(initial_model.coefficients.means, dtype)

@@ -37,6 +37,10 @@ from .normalization import NormalizationContext, identity_normalization
 
 Array = jax.Array
 
+# FULL variance on the tiled layout builds and inverts a [d, d] Hessian; above
+# this d the memory/inversion cost is unreasonable and SIMPLE is the answer.
+MAX_FULL_VARIANCE_DIM = 8192
+
 
 @jax.tree_util.register_dataclass
 @dataclasses.dataclass(frozen=True)
@@ -65,6 +69,13 @@ class GLMObjective:
     # (1/variance). With prior_mean=0 / prior_precision=1 this is plain L2.
     prior_mean: Optional[Array] = None
     prior_precision: Optional[Array] = None
+    # Pallas fusion mode (static): None = two-pass jnp path; "compiled" =
+    # single-HBM-sweep TPU kernels (ops/pallas_glm.py); "interpret" = the same
+    # kernels on the Pallas interpreter (non-TPU test parity). Set by
+    # GLMProblem.run after its concrete eligibility checks — never default-on,
+    # because a GSPMD-sharded batch must keep the jnp path (see pallas_glm
+    # module docstring).
+    fused: Optional[str] = dataclasses.field(default=None, metadata=dict(static=True))
 
     def _norm(self) -> NormalizationContext:
         return self.norm if self.norm is not None else identity_normalization()
@@ -91,15 +102,34 @@ class GLMObjective:
     def value_and_grad(self, coef: Array) -> Tuple[Array, Array]:
         b = self.batch
         norm = self._norm()
-        z, _ = self._margins(coef)
-        loss, dz = self.loss.loss_and_dz(z, b.labels)
-        wdz = b.weights * dz
-        value = jnp.sum(b.weights * loss)
-        raw_grad = b.features.rmatvec(wdz)
-        # grad_j = factor_j * (raw_grad_j - shift_j * sum_i w_i dz_i)
-        grad = raw_grad
-        if norm.shifts is not None:
-            grad = grad - norm.shifts * jnp.sum(wdz)
+        if self.fused is not None and b.features.is_dense:
+            # single-sweep Pallas kernel returns the raw aggregates; the
+            # normalization/L2 algebra below is identical to the jnp path
+            from .pallas_glm import fused_value_grad
+
+            eff, mshift = norm.effective_coefficients(coef)
+            value, raw_grad, wdz_sum = fused_value_grad(
+                b.features.dense,
+                eff,
+                b.labels,
+                b.offsets + mshift,
+                b.weights,
+                self.loss,
+                interpret=(self.fused == "interpret"),
+            )
+            grad = raw_grad
+            if norm.shifts is not None:
+                grad = grad - norm.shifts * wdz_sum
+        else:
+            z, _ = self._margins(coef)
+            loss, dz = self.loss.loss_and_dz(z, b.labels)
+            wdz = b.weights * dz
+            value = jnp.sum(b.weights * loss)
+            raw_grad = b.features.rmatvec(wdz)
+            # grad_j = factor_j * (raw_grad_j - shift_j * sum_i w_i dz_i)
+            grad = raw_grad
+            if norm.shifts is not None:
+                grad = grad - norm.shifts * jnp.sum(wdz)
         if norm.factors is not None:
             grad = grad * norm.factors
         delta = self._reg_delta(coef)
@@ -122,13 +152,34 @@ class GLMObjective:
         """
         b = self.batch
         norm = self._norm()
-        wl2 = self._d2z_weights(coef)
-        eff_v, vshift = norm.effective_coefficients(v)
-        u = b.features.matvec(eff_v) + vshift
-        c = wl2 * u
-        hv = b.features.rmatvec(c)
-        if norm.shifts is not None:
-            hv = hv - norm.shifts * jnp.sum(c)
+        if self.fused is not None and b.features.is_dense:
+            # one X sweep instead of three: z, u and the accumulation are all
+            # row-local, so the Pallas kernel computes them per tile in VMEM
+            from .pallas_glm import fused_hessian_vector
+
+            eff, mshift = norm.effective_coefficients(coef)
+            eff_v, vshift = norm.effective_coefficients(v)
+            hv, csum = fused_hessian_vector(
+                b.features.dense,
+                eff,
+                eff_v,
+                b.labels,
+                b.offsets + mshift,
+                b.weights,
+                vshift,
+                self.loss,
+                interpret=(self.fused == "interpret"),
+            )
+            if norm.shifts is not None:
+                hv = hv - norm.shifts * csum
+        else:
+            wl2 = self._d2z_weights(coef)
+            eff_v, vshift = norm.effective_coefficients(v)
+            u = b.features.matvec(eff_v) + vshift
+            c = wl2 * u
+            hv = b.features.rmatvec(c)
+            if norm.shifts is not None:
+                hv = hv - norm.shifts * jnp.sum(c)
         if norm.factors is not None:
             hv = hv * norm.factors
         hv = hv + self.l2 * self._precision(v) * v
@@ -156,10 +207,34 @@ class GLMObjective:
     def hessian_matrix(self, coef: Array) -> Array:
         """Dense d x d Hessian = X'^T diag(w l'') X' (+ l2 I). Used for FULL
         variance (diag of inverse); densifies features, so only for small d
-        (reference: HessianMatrixAggregator.scala:33-129)."""
+        (reference: HessianMatrixAggregator.scala:33-129). On the mesh-tiled
+        layout the chunked sharded xtcx path runs instead — no global
+        densification, result sharded over the model axis — with zero-activity
+        (mesh-padded) diagonal entries pinned to 1 so the matrix stays
+        invertible (same convention SIMPLE variance uses for zero diagonals)."""
         b = self.batch
         norm = self._norm()
         c = self._d2z_weights(coef)
+        if getattr(b.features, "layout", None) == "tiled":
+            if b.dim > MAX_FULL_VARIANCE_DIM:
+                raise NotImplementedError(
+                    f"variance=FULL on the tiled layout needs a [d, d] Hessian "
+                    f"inverse; d={b.dim} exceeds the supported ceiling "
+                    f"{MAX_FULL_VARIANCE_DIM} — use variance=SIMPLE"
+                )
+            if not norm.is_identity:
+                raise NotImplementedError(
+                    "normalization is not supported with the tiled layout"
+                )
+            h = b.features.xtcx(c)
+            # pin only STRUCTURAL mesh-padding dims (>= dim_true) to unit
+            # diagonal; real-but-inactive features keep the dense path's
+            # behavior (their variance is governed by l2, as in the reference)
+            d_true = getattr(b.features, "dim_true", 0) or b.dim
+            zeros_d = jnp.zeros(b.dim, h.dtype)
+            pad_pin = (jnp.arange(b.dim) >= d_true).astype(h.dtype)
+            h = h + jnp.diag(self.l2 * self._precision(zeros_d) + pad_pin)
+            return h
         x = b.features.to_dense()
         if norm.shifts is not None:
             x = x - norm.shifts[None, :]
@@ -190,6 +265,11 @@ def hvp_fn(obj: GLMObjective):
     return jax.tree_util.Partial(_hvp, obj)
 
 
+@jax.jit
+def _diag_of_inverse(m: Array) -> Array:
+    return jnp.diag(jnp.linalg.inv(m))
+
+
 def compute_variances(
     objective: GLMObjective, coef: Array, variance_type: str
 ) -> Optional[Array]:
@@ -206,5 +286,8 @@ def compute_variances(
         return 1.0 / jnp.where(d == 0, 1.0, d)
     if vt == "FULL":
         h = objective.hessian_matrix(coef)
-        return jnp.diag(jnp.linalg.inv(h))
+        # jitted module-level helper (stable cache key) so a model-axis-
+        # sharded h (tiled layout, possibly multi-process) gathers for the
+        # one-device inversion without recompiling per call
+        return _diag_of_inverse(h)
     raise ValueError(f"Unknown variance computation type: {variance_type!r}")

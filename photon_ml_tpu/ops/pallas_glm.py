@@ -1,0 +1,251 @@
+"""Pallas TPU kernels for the dense GLM hot ops: fused single-pass value+grad
+and Hessian-vector.
+
+Why a hand-written kernel when XLA already fuses elementwise ops into GEMMs:
+the two-pass structure of the dense objective cannot be fused by XLA at all.
+``value_and_grad`` is
+
+    z = X @ w          (read X)
+    dz = l'(z, y)      (elementwise)
+    g = X^T (wt * dz)  (read X again)
+
+— two GEMVs over the same X with a data dependency between them, so XLA
+schedules two full HBM sweeps of X. At GLM shapes (n >> d, X is hundreds of
+times larger than every other operand combined) the op is purely
+HBM-bandwidth-bound, so those two sweeps ARE the cost. The kernels here tile
+X over rows once and compute the margin dot, the pointwise loss, and the
+gradient accumulation per tile while it sits in VMEM — one HBM sweep, i.e. an
+asymptotic 2x on value+grad.
+
+The Hessian-vector product wins more: the objective-level composition
+
+    hv = X^T [ (wt * l''(X @ w)) * (X @ v) ]       (GLMObjective.hessian_vector)
+
+costs THREE X sweeps per call (z for the curvature weights, u = X v, and the
+transpose accumulation), and it is the inner-loop op of TRON's conjugate
+gradient (optimize/tron.py:85). Every per-row quantity (z_i, u_i, c_i) depends
+only on row i, so the fused kernel computes all three in one sweep — 3x per
+CG iteration, no caching or solver changes needed.
+
+Reference parity: these kernels compute exactly the RAW aggregates of the
+reference's ValueAndGradientAggregator / HessianVectorAggregator
+(photon-lib .../function/glm/ValueAndGradientAggregator.scala:137-161,
+HessianVectorAggregator.scala:38-173): (sum_i wt_i l_i, X^T(wt*dz),
+sum_i wt_i dz_i) and (X^T(c*u), sum_i c_i u_i). Normalization algebra
+(shift/factor identities) and L2 stay in ops/glm.py on [d]-sized vectors —
+they are free compared to the X sweep and keeping them outside the kernel
+keeps one numerics path for every layout.
+
+Gating (ops/glm.py decides per objective): dense layout, d a multiple of 128
+(the TPU lane width; no silent feature-dim padding — callers that want the
+fused path align d), rows padded to the row-tile multiple with weight-0 rows
+(pad_batch), single-device placement (a GSPMD-sharded batch would force an
+all-gather around the un-partitionable pallas_call; the sharded path keeps
+the jnp two-pass form whose collectives XLA places optimally). On non-TPU
+backends the same kernels run under ``interpret=True`` for tests.
+"""
+
+from __future__ import annotations
+
+import functools
+import os
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+from .losses import PointwiseLoss
+
+Array = jax.Array
+
+# Lane width: the feature dim must be a multiple (MXU/VPU tile constraint).
+LANE = 128
+# Per-tile VMEM budget for the X block (bytes); Mosaic double-buffers input
+# blocks, so the steady-state footprint is ~2x this.
+_X_TILE_BYTES = 4 * 1024 * 1024
+_MAX_TILE_ROWS = 2048
+_MIN_TILE_ROWS = 128
+# VMEM ceiling on the feature dim: the [1, d] coefficient/gradient rows and
+# the (TILE_N, d) X block must fit comfortably.
+MAX_FUSED_DIM = 8192
+# Below this many rows the dispatch overhead beats the saved HBM sweep.
+MIN_FUSED_ROWS = 4096
+
+
+def tile_rows(d: int) -> int:
+    """Row-tile size for feature dim d: fill the VMEM budget, stay in
+    [128, 2048], multiple of 8 (f32 sublane)."""
+    rows = _X_TILE_BYTES // (4 * max(d, 1))
+    rows = max(_MIN_TILE_ROWS, min(_MAX_TILE_ROWS, rows))
+    return (rows // 8) * 8
+
+
+def mode() -> str:
+    """Fusion mode from PHOTON_PALLAS: 'auto' (fuse on TPU), 'off',
+    'interpret' (fuse everywhere, interpreter backend — for tests)."""
+    m = os.environ.get("PHOTON_PALLAS", "auto").lower()
+    if m not in ("auto", "off", "interpret"):
+        raise ValueError(f"PHOTON_PALLAS must be auto|off|interpret, got {m!r}")
+    return m
+
+
+def eligible(n_rows: int, dim: int, dtype) -> bool:
+    """Shape/dtype eligibility for the fused kernels (row padding is the
+    caller's job; n_rows only gates the worthwhile-at-all threshold)."""
+    return (
+        dim >= LANE
+        and dim % LANE == 0
+        and dim <= MAX_FUSED_DIM
+        and n_rows >= MIN_FUSED_ROWS
+        and jnp.dtype(dtype) == jnp.float32
+    )
+
+
+def _vg_kernel(loss: PointwiseLoss, x_ref, coef_ref, y_ref, off_ref, wt_ref,
+               loss_ref, grad_ref, wdz_ref):
+    i = pl.program_id(0)
+
+    @pl.when(i == 0)
+    def _():
+        loss_ref[...] = jnp.zeros_like(loss_ref)
+        grad_ref[...] = jnp.zeros_like(grad_ref)
+        wdz_ref[...] = jnp.zeros_like(wdz_ref)
+
+    x = x_ref[...]  # [TN, d]
+    # z^T = coef[1,d] . x^T -> [1, TN]: margins for this row tile
+    z = jax.lax.dot_general(
+        coef_ref[...], x, (((1,), (1,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    ) + off_ref[...]
+    l, dz = loss.loss_and_dz(z, y_ref[...])
+    wt = wt_ref[...]
+    wdz = wt * dz  # [1, TN]
+    loss_ref[...] += jnp.sum(wt * l).reshape(1, 1)
+    wdz_ref[...] += jnp.sum(wdz).reshape(1, 1)
+    # grad += wdz[1,TN] . x[TN,d] -> [1, d]
+    grad_ref[...] += jax.lax.dot_general(
+        wdz, x, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32
+    )
+
+
+def _hv_kernel(loss: PointwiseLoss, x_ref, coef_ref, v_ref, y_ref, off_ref,
+               wt_ref, vshift_ref, hv_ref, csum_ref):
+    i = pl.program_id(0)
+
+    @pl.when(i == 0)
+    def _():
+        hv_ref[...] = jnp.zeros_like(hv_ref)
+        csum_ref[...] = jnp.zeros_like(csum_ref)
+
+    x = x_ref[...]  # [TN, d]
+    z = jax.lax.dot_general(
+        coef_ref[...], x, (((1,), (1,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    ) + off_ref[...]
+    u = jax.lax.dot_general(
+        v_ref[...], x, (((1,), (1,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    ) + vshift_ref[...]
+    cu = wt_ref[...] * loss.d2z(z, y_ref[...]) * u  # [1, TN]
+    csum_ref[...] += jnp.sum(cu).reshape(1, 1)
+    hv_ref[...] += jax.lax.dot_general(
+        cu, x, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32
+    )
+
+
+def _row_specs(tn: int, d: int):
+    """(x, coef-like [1,d]..., per-row [1,n]...) block specs for a row grid."""
+    x_spec = pl.BlockSpec((tn, d), lambda i: (i, 0))
+    d_spec = pl.BlockSpec((1, d), lambda i: (0, 0))
+    n_spec = pl.BlockSpec((1, tn), lambda i: (0, i))
+    out_d = pl.BlockSpec((1, d), lambda i: (0, 0))
+    out_s = pl.BlockSpec((1, 1), lambda i: (0, 0))
+    return x_spec, d_spec, n_spec, out_d, out_s
+
+
+@functools.partial(jax.jit, static_argnames=("loss", "interpret"))
+def fused_value_grad(
+    x: Array,
+    eff_coef: Array,
+    labels: Array,
+    offsets: Array,
+    weights: Array,
+    loss: PointwiseLoss,
+    interpret: bool = False,
+) -> Tuple[Array, Array, Array]:
+    """One-sweep (sum_i wt_i l_i, X^T(wt*dz), sum_i wt_i dz_i) over dense X.
+
+    ``offsets`` must already include the normalization margin shift; rows must
+    be padded to a multiple of tile_rows(d) with weight-0 rows.
+    """
+    n, d = x.shape
+    tn = tile_rows(d)
+    if n % tn != 0:
+        raise ValueError(f"fused kernel needs rows ({n}) % tile ({tn}) == 0")
+    dt = x.dtype
+    x_spec, d_spec, n_spec, out_d, out_s = _row_specs(tn, d)
+    loss_sum, grad, wdz_sum = pl.pallas_call(
+        functools.partial(_vg_kernel, loss),
+        grid=(n // tn,),
+        in_specs=[x_spec, d_spec, n_spec, n_spec, n_spec],
+        out_specs=[out_s, out_d, out_s],
+        out_shape=[
+            jax.ShapeDtypeStruct((1, 1), dt),
+            jax.ShapeDtypeStruct((1, d), dt),
+            jax.ShapeDtypeStruct((1, 1), dt),
+        ],
+        interpret=interpret,
+    )(
+        x,
+        eff_coef.reshape(1, d),
+        labels.reshape(1, n),
+        offsets.reshape(1, n),
+        weights.reshape(1, n),
+    )
+    return loss_sum[0, 0], grad[0], wdz_sum[0, 0]
+
+
+@functools.partial(jax.jit, static_argnames=("loss", "interpret"))
+def fused_hessian_vector(
+    x: Array,
+    eff_coef: Array,
+    eff_v: Array,
+    labels: Array,
+    offsets: Array,
+    weights: Array,
+    vshift: Array,
+    loss: PointwiseLoss,
+    interpret: bool = False,
+) -> Tuple[Array, Array]:
+    """One-sweep (X^T(c*u), sum_i c_i u_i) with c = wt*l''(z), u = X v + vshift.
+
+    Replaces the three-sweep composition in GLMObjective.hessian_vector for
+    dense X — the TRON CG inner-loop op.
+    """
+    n, d = x.shape
+    tn = tile_rows(d)
+    if n % tn != 0:
+        raise ValueError(f"fused kernel needs rows ({n}) % tile ({tn}) == 0")
+    dt = x.dtype
+    x_spec, d_spec, n_spec, out_d, out_s = _row_specs(tn, d)
+    hv, csum = pl.pallas_call(
+        functools.partial(_hv_kernel, loss),
+        grid=(n // tn,),
+        in_specs=[x_spec, d_spec, d_spec, n_spec, n_spec, n_spec, out_s],
+        out_specs=[out_d, out_s],
+        out_shape=[
+            jax.ShapeDtypeStruct((1, d), dt),
+            jax.ShapeDtypeStruct((1, 1), dt),
+        ],
+        interpret=interpret,
+    )(
+        x,
+        eff_coef.reshape(1, d),
+        eff_v.reshape(1, d),
+        labels.reshape(1, n),
+        offsets.reshape(1, n),
+        weights.reshape(1, n),
+        jnp.asarray(vshift, dt).reshape(1, 1),
+    )
+    return hv[0], csum[0, 0]

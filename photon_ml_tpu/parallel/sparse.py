@@ -61,6 +61,9 @@ class TiledSparseMatrix:
     lcol: Optional[Array] = None  # i32[D, M, m_tile], sorted per tile
     lrow: Optional[Array] = None  # i32[D, M, m_tile]
     lval: Optional[Array] = None  # f[D, M, m_tile]
+    # the UNPADDED feature dim (0 = unknown): lets consumers distinguish
+    # structural mesh padding from real-but-inactive features
+    dim_true: int = dataclasses.field(default=0, metadata=dict(static=True))
 
     @property
     def layout(self) -> str:
@@ -135,8 +138,75 @@ class TiledSparseMatrix:
     def to_dense(self) -> Array:
         raise NotImplementedError(
             "TiledSparseMatrix is for huge d; densification is not supported "
-            "(use variance_type SIMPLE, not FULL)"
+            "(use variance_type SIMPLE, or FULL which runs the chunked "
+            "sharded xtcx path without materializing X)"
         )
+
+    def xtcx(self, c: Array, row_chunk: int = 4096) -> Array:
+        """X^T diag(c) X -> [dim, dim], sharded over the model axis on dim 0:
+        the FULL-variance Hessian on the tiled layout
+        (reference: HessianMatrixAggregator.scala:92-128 — per-partition outer
+        products tree-aggregated; here per-tile chunked outer products psum'd
+        over the data axis).
+
+        Each device scans its rows in ``row_chunk`` windows: densify the local
+        (chunk x d_local) tile, all-gather the chunk's full feature rows over
+        the model axis, and accumulate the device's [d_local, dim] Hessian
+        row-block — so peak memory is O(row_chunk * dim + d_local * dim), never
+        O(n * dim). The dim ceiling is enforced by the caller
+        (ops/glm.py: MAX_FULL_VARIANCE_DIM) since [dim, dim] must be
+        invertible on one device afterwards.
+
+        Cost note: every scan step masks the tile's whole nnz array (entries
+        are column-sorted for rmatvec's fast path, so a chunk's rows are not
+        contiguous), i.e. scatter work is O(m_tile * n_chunks). To bound that
+        multiplier, ``row_chunk`` is auto-raised so n_chunks <= 64 as long as
+        the chunk's gathered rows stay under ~256 MB — a once-per-train
+        trade of memory for the serialized-scatter constant.
+        """
+        d_loc, n_loc = self.d_local, self.n_local_rows
+        mem_cap_rows = max((256 << 20) // (4 * max(self.dim, 1)), 1024)
+        row_chunk = max(row_chunk, min(-(-n_loc // 64), mem_cap_rows))
+        chunk = min(row_chunk, n_loc)
+        n_chunks = -(-n_loc // chunk)
+        n_pad = n_chunks * chunk
+        dim = self.dim
+
+        def f(lcol, lrow, lval, c_loc):
+            lc, lr, lv = lcol[0, 0], lrow[0, 0], lval[0, 0]
+            c_pad = jnp.pad(c_loc, (0, n_pad - n_loc))
+
+            def body(h, k):
+                start = k * chunk
+                in_r = (lr >= start) & (lr < start + chunk)
+                xt = (
+                    jnp.zeros((chunk, d_loc), lv.dtype)
+                    .at[jnp.where(in_r, lr - start, 0), lc]
+                    .add(jnp.where(in_r, lv, 0.0))
+                )
+                xg = jax.lax.all_gather(xt, MODEL_AXIS, axis=1, tiled=True)
+                cc = jax.lax.dynamic_slice_in_dim(c_pad, start, chunk)
+                return h + xt.T @ (cc[:, None] * xg), None
+
+            h0 = jax.lax.pcast(
+                jnp.zeros((d_loc, dim), lv.dtype),
+                (DATA_AXIS, MODEL_AXIS),
+                to="varying",
+            )
+            h, _ = jax.lax.scan(body, h0, jnp.arange(n_chunks))
+            return jax.lax.psum(h, DATA_AXIS)
+
+        return shard_map(
+            f,
+            mesh=self.mesh,
+            in_specs=(
+                P(DATA_AXIS, MODEL_AXIS, None),
+                P(DATA_AXIS, MODEL_AXIS, None),
+                P(DATA_AXIS, MODEL_AXIS, None),
+                P(DATA_AXIS),
+            ),
+            out_specs=P(MODEL_AXIS, None),
+        )(self.lcol, self.lrow, self.lval, c)
 
 
 def tile_sparse_matrix(
@@ -205,6 +275,7 @@ def tile_sparse_matrix(
         lcol=put(lcol.reshape(D_local, M, m_tile)),
         lrow=put(lrow.reshape(D_local, M, m_tile)),
         lval=put(lval.reshape(D_local, M, m_tile).astype(np.dtype(dtype))),
+        dim_true=dim,
     )
 
 

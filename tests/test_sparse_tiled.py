@@ -138,3 +138,43 @@ def test_tiled_objective_value_grad_parity(rng):
         np.asarray(obj_d.hessian_diagonal(jnp.asarray(w))),
         rtol=1e-9,
     )
+
+
+@pytest.mark.parametrize("shape", [(4, 2), (2, 4), (8, 1), (1, 8)])
+def test_tiled_full_variance_matches_dense(rng, shape):
+    """variance=FULL on the tiled layout (round-3 missing item 5): the chunked
+    sharded X^T diag(c) X equals the dense full Hessian, and the resulting
+    diag-of-inverse variances match the dense FULL path on the true dims."""
+    from photon_ml_tpu.ops.glm import compute_variances
+
+    n, d, k = 128, 101, 3  # d not a multiple of the model axis: padded dims
+    rows, cols, vals = _random_coo(rng, n, d, k)
+    x = _dense_of(rows, cols, vals, n, d)
+    y = (rng.uniform(size=n) < 0.5).astype(np.float64)
+    mesh = make_mesh(n_data=shape[0], n_model=shape[1])
+    tb = tiled_sparse_batch(rows, cols, vals, y, d, mesh, dtype=jnp.float64)
+    obj_t = GLMObjective(loss=LOGISTIC, batch=tb, l2=0.25)
+    obj_d = GLMObjective(
+        loss=LOGISTIC, batch=batch_from_dense(x, y, dtype=jnp.float64), l2=0.25
+    )
+    w = rng.normal(size=d) * 0.3
+    w_pad = np.zeros(tb.features.dim)
+    w_pad[:d] = w
+    w_t = replicated_coefficients(w_pad, mesh, jnp.float64)
+
+    h_t = np.asarray(obj_t.hessian_matrix(w_t))
+    h_d = np.asarray(obj_d.hessian_matrix(jnp.asarray(w)))
+    np.testing.assert_allclose(h_t[:d, :d], h_d, rtol=1e-9, atol=1e-12)
+    # padded dims: unit diagonal, zero off-diagonal (invertible, inert)
+    pad = tb.features.dim - d
+    if pad:
+        np.testing.assert_allclose(h_t[d:, d:], np.eye(pad) * (1.0 + 0.25))
+        assert np.all(h_t[:d, d:] == 0) and np.all(h_t[d:, :d] == 0)
+
+    v_t = np.asarray(compute_variances(obj_t, w_t, "FULL"))
+    v_d = np.asarray(compute_variances(obj_d, jnp.asarray(w), "FULL"))
+    np.testing.assert_allclose(v_t[:d], v_d, rtol=1e-8)
+
+    # a small row_chunk exercises the multi-chunk scan path
+    h_chunked = np.asarray(tb.features.xtcx(obj_t._d2z_weights(w_t), row_chunk=16))
+    np.testing.assert_allclose(h_chunked[:d, :d] , h_d - 0.25 * np.eye(d), rtol=1e-9, atol=1e-12)
