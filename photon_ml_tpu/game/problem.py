@@ -30,32 +30,47 @@ from ..optimize import OptimizerConfig, SolverResult, optimize
 Array = jax.Array
 
 
-def _fusion_mode(batch: LabeledBatch) -> Optional[str]:
+def _fusion_mode(batch: LabeledBatch):
     """Decide whether this batch takes the single-sweep Pallas kernels
-    (ops/pallas_glm.py): dense layout, eligible shapes/dtype, concretely
-    placed on ONE device. GSPMD-sharded batches keep the jnp two-pass path —
-    a pallas_call has no partitioning rule, so XLA would all-gather the
-    sharded X around it."""
+    (ops/pallas_glm.py). Returns (mode, mesh): mode None = jnp two-pass path;
+    mesh is set when the batch is DATA-axis-sharded over >1 device, in which
+    case the kernels run per-shard under shard_map + psum (a bare pallas_call
+    has no GSPMD partitioning rule — without the explicit shard_map XLA would
+    all-gather the sharded X around it). Model-axis-sharded dense batches
+    keep the jnp path."""
     from ..ops import pallas_glm
 
+    none = (None, None)
     mode = pallas_glm.mode()
     if mode == "off":
-        return None
+        return none
     f = batch.features
     if not f.is_dense:
-        return None
+        return none
     x = f.dense
     if isinstance(x, jax.core.Tracer):
-        return None
+        return none
     n, d = x.shape
     if not pallas_glm.eligible(n, d, x.dtype):
-        return None
+        return none
+    mesh = None
     sharding = getattr(x, "sharding", None)
     if sharding is not None and len(getattr(sharding, "device_set", ())) > 1:
-        return None
+        from jax.sharding import NamedSharding
+        from ..parallel.mesh import DATA_AXIS
+
+        if not isinstance(sharding, NamedSharding):
+            return none
+        spec = tuple(sharding.spec)
+        # rows on the data axis, feature dim unsharded
+        if len(spec) == 0 or spec[0] != DATA_AXIS:
+            return none
+        if any(s is not None for s in spec[1:]):
+            return none
+        mesh = sharding.mesh
     if mode == "interpret":
-        return "interpret"
-    return "compiled" if jax.default_backend() == "tpu" else None
+        return "interpret", mesh
+    return ("compiled", mesh) if jax.default_backend() == "tpu" else none
 
 
 def _pad_dim(v: Array, dim: int, fill: float) -> Array:
@@ -102,7 +117,10 @@ class GLMProblem:
     prior: Optional[Coefficients] = None
 
     def objective(
-        self, batch: LabeledBatch, fused: Optional[str] = None
+        self,
+        batch: LabeledBatch,
+        fused: Optional[str] = None,
+        fused_mesh=None,
     ) -> GLMObjective:
         prior_mean = prior_precision = None
         if self.prior is not None:
@@ -128,6 +146,7 @@ class GLMProblem:
             prior_mean=prior_mean,
             prior_precision=prior_precision,
             fused=fused,
+            fused_mesh=fused_mesh,
         )
 
     def run(
@@ -155,18 +174,8 @@ class GLMProblem:
                     f"inverse; d={batch.dim} exceeds the supported ceiling "
                     f"{MAX_FULL_VARIANCE_DIM} — use variance=SIMPLE"
                 )
-        fused = _fusion_mode(batch)
-        if fused is not None:
-            # pad rows once (weight 0) to the kernel's row-tile multiple; the
-            # feature dim is untouched, so models/variances need no trimming
-            from ..ops.pallas_glm import tile_rows
-            from ..ops.features import pad_batch
-
-            tn = tile_rows(batch.dim)
-            target = ((batch.n_rows + tn - 1) // tn) * tn
-            if target != batch.n_rows:
-                batch = pad_batch(batch, target)
-        obj = self.objective(batch, fused=fused)
+        fused, fused_mesh = _fusion_mode(batch)
+        obj = self.objective(batch, fused=fused, fused_mesh=fused_mesh)
         dtype = batch.labels.dtype
         if initial_model is not None:
             w0 = jnp.asarray(initial_model.coefficients.means, dtype)

@@ -72,10 +72,15 @@ class GLMObjective:
     # Pallas fusion mode (static): None = two-pass jnp path; "compiled" =
     # single-HBM-sweep TPU kernels (ops/pallas_glm.py); "interpret" = the same
     # kernels on the Pallas interpreter (non-TPU test parity). Set by
-    # GLMProblem.run after its concrete eligibility checks — never default-on,
-    # because a GSPMD-sharded batch must keep the jnp path (see pallas_glm
-    # module docstring).
+    # GLMProblem.run after its concrete eligibility checks — never default-on.
     fused: Optional[str] = dataclasses.field(default=None, metadata=dict(static=True))
+    # When the batch is sharded over a mesh's DATA axis, the fused kernels run
+    # per-shard under shard_map with an explicit psum (pallas_call has no
+    # GSPMD partitioning rule; without this a sharded batch must keep the jnp
+    # path). None = single-device placement.
+    fused_mesh: Optional[object] = dataclasses.field(
+        default=None, metadata=dict(static=True)
+    )
 
     def _norm(self) -> NormalizationContext:
         return self.norm if self.norm is not None else identity_normalization()
@@ -105,16 +110,12 @@ class GLMObjective:
         if self.fused is not None and b.features.is_dense:
             # single-sweep Pallas kernel returns the raw aggregates; the
             # normalization/L2 algebra below is identical to the jnp path
-            from .pallas_glm import fused_value_grad
+            from .pallas_glm import sharded_value_grad
 
             eff, mshift = norm.effective_coefficients(coef)
-            value, raw_grad, wdz_sum = fused_value_grad(
-                b.features.dense,
-                eff,
-                b.labels,
-                b.offsets + mshift,
-                b.weights,
-                self.loss,
+            value, raw_grad, wdz_sum = sharded_value_grad(
+                self.fused_mesh, b.features.dense, eff, b.labels,
+                b.offsets + mshift, b.weights, self.loss,
                 interpret=(self.fused == "interpret"),
             )
             grad = raw_grad
@@ -155,19 +156,13 @@ class GLMObjective:
         if self.fused is not None and b.features.is_dense:
             # one X sweep instead of three: z, u and the accumulation are all
             # row-local, so the Pallas kernel computes them per tile in VMEM
-            from .pallas_glm import fused_hessian_vector
+            from .pallas_glm import sharded_hessian_vector
 
             eff, mshift = norm.effective_coefficients(coef)
             eff_v, vshift = norm.effective_coefficients(v)
-            hv, csum = fused_hessian_vector(
-                b.features.dense,
-                eff,
-                eff_v,
-                b.labels,
-                b.offsets + mshift,
-                b.weights,
-                vshift,
-                self.loss,
+            hv, csum = sharded_hessian_vector(
+                self.fused_mesh, b.features.dense, eff, eff_v, b.labels,
+                b.offsets + mshift, b.weights, vshift, self.loss,
                 interpret=(self.fused == "interpret"),
             )
             if norm.shifts is not None:

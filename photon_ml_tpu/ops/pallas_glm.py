@@ -36,13 +36,15 @@ sum_i wt_i dz_i) and (X^T(c*u), sum_i c_i u_i). Normalization algebra
 they are free compared to the X sweep and keeping them outside the kernel
 keeps one numerics path for every layout.
 
-Gating (ops/glm.py decides per objective): dense layout, d a multiple of 128
-(the TPU lane width; no silent feature-dim padding — callers that want the
-fused path align d), rows padded to the row-tile multiple with weight-0 rows
-(pad_batch), single-device placement (a GSPMD-sharded batch would force an
-all-gather around the un-partitionable pallas_call; the sharded path keeps
-the jnp two-pass form whose collectives XLA places optimally). On non-TPU
-backends the same kernels run under ``interpret=True`` for tests.
+Gating (game/problem.py decides per objective): dense layout, d a multiple of
+128 (the TPU lane width; no silent feature-dim padding — callers that want
+the fused path align d), any row count (the last partial tile is select-
+masked in-kernel). Placement: single-device batches call the kernel
+directly; DATA-axis-sharded batches run it per-shard under an explicit
+shard_map + psum (sharded_value_grad / sharded_hessian_vector) because a
+bare pallas_call has no GSPMD partitioning rule. Model-axis-sharded dense
+batches keep the jnp two-pass path. On non-TPU backends the same kernels run
+under ``interpret=True`` for tests.
 """
 
 from __future__ import annotations
@@ -91,8 +93,9 @@ def mode() -> str:
 
 
 def eligible(n_rows: int, dim: int, dtype) -> bool:
-    """Shape/dtype eligibility for the fused kernels (row padding is the
-    caller's job; n_rows only gates the worthwhile-at-all threshold)."""
+    """Shape/dtype eligibility for the fused kernels. Any row count works
+    (partial last tile is masked in-kernel); n_rows only gates the
+    worthwhile-at-all threshold."""
     return (
         dim >= LANE
         and dim % LANE == 0
@@ -102,8 +105,28 @@ def eligible(n_rows: int, dim: int, dtype) -> bool:
     )
 
 
-def _vg_kernel(loss: PointwiseLoss, x_ref, coef_ref, y_ref, off_ref, wt_ref,
-               loss_ref, grad_ref, wdz_ref):
+def _load_tile(rem: int, tn: int, masked: bool, x_ref, y_ref, off_ref, wt_ref):
+    """Load one row tile; with ``masked``, neutralize rows >= rem.
+
+    The grid is cdiv(n, tn), so when tn does not divide n the LAST tile reads
+    past the array — Pallas pads boundary blocks with UNSPECIFIED values
+    (possibly inf/nan, which would poison the accumulating dots even at
+    weight 0, since 0*nan=nan). Only that one tile takes the masked load; all
+    full tiles skip the selects entirely (the split is static, see callers).
+    """
+    if not masked:
+        return x_ref[...], y_ref[...], off_ref[...], wt_ref[...]
+    lane = jax.lax.broadcasted_iota(jnp.int32, (1, tn), 1) < rem
+    sub = jax.lax.broadcasted_iota(jnp.int32, (tn, 1), 0) < rem
+    x = jnp.where(sub, x_ref[...], 0.0)  # [TN, d]
+    y = jnp.where(lane, y_ref[...], 0.0)  # [1, TN]
+    off = jnp.where(lane, off_ref[...], 0.0)
+    wt = jnp.where(lane, wt_ref[...], 0.0)
+    return x, y, off, wt
+
+
+def _vg_kernel(loss: PointwiseLoss, n: int, tn: int, x_ref, coef_ref, y_ref,
+               off_ref, wt_ref, loss_ref, grad_ref, wdz_ref):
     i = pl.program_id(0)
 
     @pl.when(i == 0)
@@ -112,25 +135,32 @@ def _vg_kernel(loss: PointwiseLoss, x_ref, coef_ref, y_ref, off_ref, wt_ref,
         grad_ref[...] = jnp.zeros_like(grad_ref)
         wdz_ref[...] = jnp.zeros_like(wdz_ref)
 
-    x = x_ref[...]  # [TN, d]
-    # z^T = coef[1,d] . x^T -> [1, TN]: margins for this row tile
-    z = jax.lax.dot_general(
-        coef_ref[...], x, (((1,), (1,)), ((), ())),
-        preferred_element_type=jnp.float32,
-    ) + off_ref[...]
-    l, dz = loss.loss_and_dz(z, y_ref[...])
-    wt = wt_ref[...]
-    wdz = wt * dz  # [1, TN]
-    loss_ref[...] += jnp.sum(wt * l).reshape(1, 1)
-    wdz_ref[...] += jnp.sum(wdz).reshape(1, 1)
-    # grad += wdz[1,TN] . x[TN,d] -> [1, d]
-    grad_ref[...] += jax.lax.dot_general(
-        wdz, x, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32
-    )
+    def accumulate(masked):
+        x, y, off, wt = _load_tile(n % tn, tn, masked, x_ref, y_ref, off_ref, wt_ref)
+        # z^T = coef[1,d] . x^T -> [1, TN]: margins for this row tile
+        z = jax.lax.dot_general(
+            coef_ref[...], x, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        ) + off
+        l, dz = loss.loss_and_dz(z, y)
+        wdz = wt * dz  # [1, TN]
+        loss_ref[...] += jnp.sum(wt * l).reshape(1, 1)
+        wdz_ref[...] += jnp.sum(wdz).reshape(1, 1)
+        # grad += wdz[1,TN] . x[TN,d] -> [1, d]
+        grad_ref[...] += jax.lax.dot_general(
+            wdz, x, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32
+        )
+
+    if n % tn == 0:
+        accumulate(False)
+    else:
+        last = pl.cdiv(n, tn) - 1
+        pl.when(i < last)(lambda: accumulate(False))
+        pl.when(i == last)(lambda: accumulate(True))
 
 
-def _hv_kernel(loss: PointwiseLoss, x_ref, coef_ref, v_ref, y_ref, off_ref,
-               wt_ref, vshift_ref, hv_ref, csum_ref):
+def _hv_kernel(loss: PointwiseLoss, n: int, tn: int, x_ref, coef_ref, v_ref,
+               y_ref, off_ref, wt_ref, vshift_ref, hv_ref, csum_ref):
     i = pl.program_id(0)
 
     @pl.when(i == 0)
@@ -138,20 +168,28 @@ def _hv_kernel(loss: PointwiseLoss, x_ref, coef_ref, v_ref, y_ref, off_ref,
         hv_ref[...] = jnp.zeros_like(hv_ref)
         csum_ref[...] = jnp.zeros_like(csum_ref)
 
-    x = x_ref[...]  # [TN, d]
-    z = jax.lax.dot_general(
-        coef_ref[...], x, (((1,), (1,)), ((), ())),
-        preferred_element_type=jnp.float32,
-    ) + off_ref[...]
-    u = jax.lax.dot_general(
-        v_ref[...], x, (((1,), (1,)), ((), ())),
-        preferred_element_type=jnp.float32,
-    ) + vshift_ref[...]
-    cu = wt_ref[...] * loss.d2z(z, y_ref[...]) * u  # [1, TN]
-    csum_ref[...] += jnp.sum(cu).reshape(1, 1)
-    hv_ref[...] += jax.lax.dot_general(
-        cu, x, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32
-    )
+    def accumulate(masked):
+        x, y, off, wt = _load_tile(n % tn, tn, masked, x_ref, y_ref, off_ref, wt_ref)
+        z = jax.lax.dot_general(
+            coef_ref[...], x, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        ) + off
+        u = jax.lax.dot_general(
+            v_ref[...], x, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        ) + vshift_ref[...]
+        cu = wt * loss.d2z(z, y) * u  # [1, TN]
+        csum_ref[...] += jnp.sum(cu).reshape(1, 1)
+        hv_ref[...] += jax.lax.dot_general(
+            cu, x, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32
+        )
+
+    if n % tn == 0:
+        accumulate(False)
+    else:
+        last = pl.cdiv(n, tn) - 1
+        pl.when(i < last)(lambda: accumulate(False))
+        pl.when(i == last)(lambda: accumulate(True))
 
 
 def _row_specs(tn: int, d: int):
@@ -176,18 +214,16 @@ def fused_value_grad(
 ) -> Tuple[Array, Array, Array]:
     """One-sweep (sum_i wt_i l_i, X^T(wt*dz), sum_i wt_i dz_i) over dense X.
 
-    ``offsets`` must already include the normalization margin shift; rows must
-    be padded to a multiple of tile_rows(d) with weight-0 rows.
+    ``offsets`` must already include the normalization margin shift. Any row
+    count works: the last (partial) tile is select-masked in-kernel.
     """
     n, d = x.shape
     tn = tile_rows(d)
-    if n % tn != 0:
-        raise ValueError(f"fused kernel needs rows ({n}) % tile ({tn}) == 0")
     dt = x.dtype
     x_spec, d_spec, n_spec, out_d, out_s = _row_specs(tn, d)
     loss_sum, grad, wdz_sum = pl.pallas_call(
-        functools.partial(_vg_kernel, loss),
-        grid=(n // tn,),
+        functools.partial(_vg_kernel, loss, n, tn),
+        grid=(pl.cdiv(n, tn),),
         in_specs=[x_spec, d_spec, n_spec, n_spec, n_spec],
         out_specs=[out_s, out_d, out_s],
         out_shape=[
@@ -204,6 +240,91 @@ def fused_value_grad(
         weights.reshape(1, n),
     )
     return loss_sum[0, 0], grad[0], wdz_sum[0, 0]
+
+
+def sharded_value_grad(
+    mesh,
+    x: Array,
+    eff_coef: Array,
+    labels: Array,
+    offsets: Array,
+    weights: Array,
+    loss: PointwiseLoss,
+    interpret: bool = False,
+) -> Tuple[Array, Array, Array]:
+    """fused_value_grad over a DATA-axis-sharded batch: each device sweeps its
+    own row shard with the Pallas kernel, the three raw aggregates psum over
+    the data axis (the reference's treeAggregate, SURVEY.md P1 — here an
+    explicit shard_map because pallas_call has no GSPMD partitioning rule).
+    mesh=None delegates to the single-device kernel, so callers keep ONE call
+    site for both placements."""
+    if mesh is None:
+        return fused_value_grad(
+            x, eff_coef, labels, offsets, weights, loss, interpret=interpret
+        )
+    from jax import shard_map
+    from jax.sharding import PartitionSpec as P
+
+    from ..parallel.mesh import DATA_AXIS  # lazy: parallel imports ops
+
+    def f(x_l, eff_l, y_l, off_l, wt_l):
+        ls, g, ws = fused_value_grad(x_l, eff_l, y_l, off_l, wt_l, loss, interpret)
+        return (
+            jax.lax.psum(ls, DATA_AXIS),
+            jax.lax.psum(g, DATA_AXIS),
+            jax.lax.psum(ws, DATA_AXIS),
+        )
+
+    return shard_map(
+        f,
+        mesh=mesh,
+        in_specs=(P(DATA_AXIS, None), P(), P(DATA_AXIS), P(DATA_AXIS), P(DATA_AXIS)),
+        out_specs=(P(), P(), P()),
+        # pallas_call cannot annotate vma on its out_shape structs
+        check_vma=False,
+    )(x, eff_coef, labels, offsets, weights)
+
+
+def sharded_hessian_vector(
+    mesh,
+    x: Array,
+    eff_coef: Array,
+    eff_v: Array,
+    labels: Array,
+    offsets: Array,
+    weights: Array,
+    vshift: Array,
+    loss: PointwiseLoss,
+    interpret: bool = False,
+) -> Tuple[Array, Array]:
+    """fused_hessian_vector over a DATA-axis-sharded batch (see
+    sharded_value_grad). mesh=None delegates to the single-device kernel."""
+    if mesh is None:
+        return fused_hessian_vector(
+            x, eff_coef, eff_v, labels, offsets, weights, vshift, loss,
+            interpret=interpret,
+        )
+    from jax import shard_map
+    from jax.sharding import PartitionSpec as P
+
+    from ..parallel.mesh import DATA_AXIS
+
+    def f(x_l, eff_l, v_l, y_l, off_l, wt_l, vs_l):
+        hv, cs = fused_hessian_vector(
+            x_l, eff_l, v_l, y_l, off_l, wt_l, vs_l, loss, interpret
+        )
+        return jax.lax.psum(hv, DATA_AXIS), jax.lax.psum(cs, DATA_AXIS)
+
+    return shard_map(
+        f,
+        mesh=mesh,
+        in_specs=(
+            P(DATA_AXIS, None), P(), P(), P(DATA_AXIS), P(DATA_AXIS),
+            P(DATA_AXIS), P(),
+        ),
+        out_specs=(P(), P()),
+        check_vma=False,
+    )(x, eff_coef, eff_v, labels, offsets, weights, jnp.asarray(vshift, x.dtype))
 
 
 @functools.partial(jax.jit, static_argnames=("loss", "interpret"))
@@ -225,13 +346,11 @@ def fused_hessian_vector(
     """
     n, d = x.shape
     tn = tile_rows(d)
-    if n % tn != 0:
-        raise ValueError(f"fused kernel needs rows ({n}) % tile ({tn}) == 0")
     dt = x.dtype
     x_spec, d_spec, n_spec, out_d, out_s = _row_specs(tn, d)
     hv, csum = pl.pallas_call(
-        functools.partial(_hv_kernel, loss),
-        grid=(n // tn,),
+        functools.partial(_hv_kernel, loss, n, tn),
+        grid=(pl.cdiv(n, tn),),
         in_specs=[x_spec, d_spec, d_spec, n_spec, n_spec, n_spec, out_s],
         out_specs=[out_d, out_s],
         out_shape=[
