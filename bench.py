@@ -585,21 +585,43 @@ def bench_hbm_attribution(n=500_000, d=1024, repeats=30):
     float(v)  # sync
     in_loop = bytes_per_call * repeats / (time.perf_counter() - t0) / 1e9
 
-    @jax.jit
-    def vg_chain(b, w):
-        def body(_, carry):
-            w, acc = carry
-            v, g = GLMObjective(loss=LOGISTIC, batch=b, l2=1.0).value_and_grad(w)
-            return (w - 1e-12 * g, acc + v)
+    def make_chain(fused):
+        @jax.jit
+        def vg_chain(b, w):
+            def body(_, carry):
+                w, acc = carry
+                v, g = GLMObjective(
+                    loss=LOGISTIC, batch=b, l2=1.0, fused=fused
+                ).value_and_grad(w)
+                return (w - 1e-12 * g, acc + v)
 
-        return jax.lax.fori_loop(0, repeats, body, (w, 0.0))
+            return jax.lax.fori_loop(0, repeats, body, (w, 0.0))
 
-    wf, acc = vg_chain(batch, w)
-    float(acc)  # compile + true sync
-    t0 = time.perf_counter()
-    wf, acc = vg_chain(batch, w)
-    float(acc)  # sync
-    kernel_only = bytes_per_call * repeats / (time.perf_counter() - t0) / 1e9
+        return vg_chain
+
+    def run_chain(chain):
+        wf, acc = chain(batch, w)
+        float(acc)  # compile + true sync
+        t0 = time.perf_counter()
+        wf, acc = chain(batch, w)
+        float(acc)  # sync
+        return (time.perf_counter() - t0) / repeats
+
+    t_jnp = run_chain(make_chain(None))
+    kernel_only = bytes_per_call / t_jnp / 1e9
+
+    # single-HBM-sweep Pallas kernel (ops/pallas_glm.py): same chained
+    # discipline; its true traffic is ONE sweep of X per call
+    pallas_line = ""
+    if jax.default_backend() == "tpu":
+        t_pal = run_chain(make_chain("compiled"))
+        pallas_gbs = (bytes_per_call / 2) / t_pal / 1e9
+        speedup = t_jnp / t_pal
+        pallas_line = (
+            f"; pallas single-sweep kernel {t_pal * 1e3:.2f} ms/call "
+            f"({pallas_gbs:.1f} GB/s on its 1-sweep traffic) vs jnp two-pass "
+            f"{t_jnp * 1e3:.2f} ms/call — {speedup:.2f}x per value+grad"
+        )
 
     return {
         "metric": "fused_value_grad_hbm_bandwidth",
@@ -608,6 +630,7 @@ def bench_hbm_attribution(n=500_000, d=1024, repeats=30):
             f"GB/s kernel-only (fori_loop-chained, no host dispatch) vs "
             f"{in_loop:.1f} GB/s in-loop (per-call dispatch), n={n} d={d} "
             "f32; ratio isolates remote-tunnel dispatch cost from kernel cost"
+            + pallas_line
         ),
         "vs_baseline": round(kernel_only / in_loop, 2),
     }

@@ -14,6 +14,7 @@ descent loop owns residual composition (CoordinateDataScores semantics, P7).
 from __future__ import annotations
 
 import dataclasses
+import weakref
 from functools import partial
 from typing import Optional, Tuple, Union
 
@@ -286,7 +287,46 @@ class RandomEffectCoordinate(Coordinate):
             coef_indices=coef_indices,
             coef_values=jnp.where(valid, w_sub, 0.0),
         )
+        # provenance mark (weakref: must not pin the dataset's device arrays
+        # to the model's lifetime): this model's support layout IS this
+        # dataset's block layout, so score() can take the cached-positions
+        # fast path without fetching/comparing the [E, S] index arrays
+        object.__setattr__(model, "_support_layout_of", weakref.ref(self.dataset))
         return model, results
+
+    def _support_layout_matches(self, model: RandomEffectModel) -> bool:
+        """True when model.coef_indices is this dataset's own block layout
+        (the coordinate-descent case). Checks provenance/identity first;
+        falls back to a memoized array comparison (bounded FIFO memo holding
+        strong refs, so a GC'd array's id cannot alias a stale entry; the
+        host proj_cols fetch is cached on the dataset)."""
+        ds = self.dataset
+        prov = getattr(model, "_support_layout_of", None)
+        if prov is not None and prov() is ds:
+            return True
+        ci = model.coef_indices
+        if ci is ds.blocks.proj_cols:
+            return True
+        memo = getattr(ds, "_layout_match_memo", None)
+        if memo is None:
+            memo = {}
+            object.__setattr__(ds, "_layout_match_memo", memo)
+        hit = memo.get(id(ci))
+        if hit is not None and hit[0] is ci:
+            return hit[1]
+        pc_host = getattr(ds, "_host_proj_cols_cache", None)
+        if pc_host is None:
+            pc_host = ds.host_proj_cols
+            if pc_host is None:
+                pc_host = np.asarray(ds.blocks.proj_cols)
+            object.__setattr__(ds, "_host_proj_cols_cache", pc_host)
+        ok = tuple(ci.shape) == tuple(np.shape(pc_host)) and np.array_equal(
+            np.asarray(ci), pc_host
+        )
+        while len(memo) >= 8:  # bounded: drop oldest entries
+            memo.pop(next(iter(memo)))
+        memo[id(ci)] = (ci, ok)
+        return ok
 
     def score(self, model: RandomEffectModel) -> Array:
         row_entity = self.dataset.row_entity
@@ -297,6 +337,24 @@ class RandomEffectCoordinate(Coordinate):
         # processes (multi-process) as well as single-host.
         ds_ids = list(map(str, self.dataset.entity_ids))
         m_ids = list(map(str, model.entity_ids))
+        if ds_ids == m_ids and self._support_layout_matches(model):
+            # coordinate-descent hot path: the support LAYOUT is this
+            # dataset's own block layout, so the searchsorted feature->support
+            # mapping is computed once and cached; each sweep's score is then
+            # a single flat gather (models/game.py score_entity_ell_at)
+            from ..models.game import ell_support_positions, score_entity_ell_at
+
+            cache = getattr(self.dataset, "_score_pos_cache", None)
+            if cache is None:
+                cache = ell_support_positions(
+                    model.coef_indices, row_entity, self.dataset.ell_idx
+                )
+                object.__setattr__(self.dataset, "_score_pos_cache", cache)
+            pos, hit = cache
+            vals = jnp.asarray(model.coef_values, self.dataset.ell_val.dtype)
+            return score_entity_ell_at(
+                vals, row_entity, pos, hit, self.dataset.ell_val
+            )
         if ds_ids != m_ids:
             block_to_model = model.rows_for(self.dataset.entity_ids).astype(np.int32)
             row_entity = jnp.where(

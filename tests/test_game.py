@@ -315,6 +315,50 @@ def test_re_score_with_reordered_model_entities(mixed):
     np.testing.assert_allclose(np.asarray(coord.score(shuffled)), base, atol=1e-12)
 
 
+def test_re_score_cached_positions_match_general_path(mixed):
+    """The CD hot path caches the feature->support searchsorted once per
+    dataset (models/game.py score_entity_ell_at); it must equal the general
+    searchsorted-per-call path bit-for-bit, on first AND repeat calls."""
+    from photon_ml_tpu.models.game import score_entity_ell
+
+    data, raw = mixed
+    ds = build_random_effect_dataset(
+        raw, "per-user", "userShard", "userId", dtype=jnp.float64
+    )
+    coord = RandomEffectCoordinate(dataset=ds, task="logistic_regression", config=_cfg())
+    model, _ = coord.train(None, None)
+    assert coord._support_layout_matches(model)
+    general = np.asarray(
+        score_entity_ell(
+            model.coef_indices,
+            jnp.asarray(model.coef_values, ds.ell_val.dtype),
+            ds.row_entity,
+            ds.ell_idx,
+            ds.ell_val,
+        )
+    )
+    first = np.asarray(coord.score(model))
+    again = np.asarray(coord.score(model))  # cache hit
+    assert getattr(ds, "_score_pos_cache", None) is not None
+    np.testing.assert_array_equal(first, general)
+    np.testing.assert_array_equal(again, general)
+
+    # a second trained model (new values, same layout) reuses the cache
+    model2, _ = coord.train(coord.score(model), initial_model=model)
+    np.testing.assert_array_equal(
+        np.asarray(coord.score(model2)),
+        np.asarray(
+            score_entity_ell(
+                model2.coef_indices,
+                jnp.asarray(model2.coef_values, ds.ell_val.dtype),
+                ds.row_entity,
+                ds.ell_idx,
+                ds.ell_val,
+            )
+        ),
+    )
+
+
 def test_re_dataset_all_entities_below_lower_bound(mixed):
     """No entity meeting the lower bound must yield empty padded blocks, not a
     crash (review regression)."""
