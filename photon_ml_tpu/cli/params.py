@@ -72,6 +72,17 @@ def parse_coordinate(spec: str) -> CoordinateConfig:
     layout = kv.pop("layout", "auto").lower()
     if layout not in ("auto", "dense", "ell", "sparse", "coo", "tiled"):
         raise ValueError(f"unknown layout {layout!r} in coordinate {name!r}")
+    fdt_name = kv.pop("feature.dtype", "").lower()
+    if fdt_name not in ("", "float32", "bfloat16"):
+        raise ValueError(
+            f"unknown feature.dtype {fdt_name!r} in coordinate {name!r} "
+            "(expected float32|bfloat16)"
+        )
+    feature_dtype = None
+    if fdt_name == "bfloat16":
+        import jax.numpy as jnp
+
+        feature_dtype = jnp.bfloat16
     cc = CoordinateConfig(
         name=name,
         feature_shard=shard,
@@ -86,6 +97,7 @@ def parse_coordinate(spec: str) -> CoordinateConfig:
             else None
         ),
         layout=layout,
+        feature_dtype=feature_dtype,
     )
     kv.pop("active.cap", None)
     if kv:

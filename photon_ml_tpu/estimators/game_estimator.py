@@ -64,6 +64,10 @@ class CoordinateConfig:
     # fixed-effect batch layout: auto|dense|ell|coo|tiled ('tiled' shards the
     # coefficient dim over the estimator mesh's model axis — the huge-d path)
     layout: str = "auto"
+    # optional narrower storage type for the dense feature matrix only (e.g.
+    # jnp.bfloat16: halves the HBM traffic of the bandwidth-bound objective
+    # sweeps; labels/offsets/weights/solver state stay in estimator dtype)
+    feature_dtype: Optional[object] = None
     normalization: Optional[NormalizationContext] = None
     # incremental training: L2-regularize toward the warm-start model
     # ("Regularize by Previous Model During Warm-Start Training")
@@ -125,6 +129,19 @@ class GameEstimator(EventEmitter):
         if unknown:
             raise ValueError(f"locked coordinates not in configs: {sorted(unknown)}")
         for cc in self.coordinate_configs:
+            if cc.feature_dtype is not None and (
+                cc.is_random_effect or cc.layout != "dense"
+            ):
+                # 'auto' is rejected too: it can resolve to ELL at fit time
+                # (d > 4096), which would fail deep inside data loading
+                # without the coordinate name — require an explicit dense
+                raise ValueError(
+                    f"coordinate {cc.name}: feature_dtype requires "
+                    "layout=dense on a fixed-effect coordinate "
+                    f"(got layout={cc.layout!r}"
+                    + (", random effect" if cc.is_random_effect else "")
+                    + ")"
+                )
             if cc.layout == "tiled":
                 if mesh is None:
                     raise ValueError(
@@ -202,6 +219,7 @@ class GameEstimator(EventEmitter):
                         dtype=self.dtype,
                         layout=cc.layout,
                         mesh=self.mesh,
+                        feature_dtype=cc.feature_dtype,
                     )
                     if self.mesh is not None and cc.layout != "tiled":
                         from ..parallel.mesh import shard_batch

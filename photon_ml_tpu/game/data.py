@@ -214,11 +214,15 @@ def build_fixed_effect_dataset(
     dtype=jnp.float32,
     layout: str = "auto",
     mesh=None,
+    feature_dtype=None,
 ) -> FixedEffectDataset:
     return FixedEffectDataset(
         coordinate_id=coordinate_id,
         feature_shard=feature_shard,
-        batch=raw.to_batch(feature_shard, dtype=dtype, layout=layout, mesh=mesh),
+        batch=raw.to_batch(
+            feature_shard, dtype=dtype, layout=layout, mesh=mesh,
+            feature_dtype=feature_dtype,
+        ),
         true_dim=raw.shard_dims[feature_shard],
         true_n_rows=raw.n_rows,
     )

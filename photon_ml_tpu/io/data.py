@@ -109,7 +109,10 @@ class RawDataset:
             true_rows=self.n_rows if self.true_rows is None else self.true_rows,
         )
 
-    def to_batch(self, shard: str, dtype=None, layout: str = "auto", mesh=None):
+    def to_batch(
+        self, shard: str, dtype=None, layout: str = "auto", mesh=None,
+        feature_dtype=None,
+    ):
         """Build a device LabeledBatch for one feature shard.
 
         layout: 'auto' (dense when d <= 4096, else ELL) | 'dense' |
@@ -117,6 +120,10 @@ class RawDataset:
         'coo' (column-sorted COO, huge d single-device) |
         'tiled' ((data x model)-mesh-tiled sparse, huge d sharded; requires
         ``mesh`` — see parallel/sparse.py).
+
+        feature_dtype: optional narrower storage type for the FEATURE matrix
+        only (dense layout; e.g. bfloat16 halves the HBM traffic of the
+        objective sweeps on TPU). Labels/offsets/weights stay ``dtype``.
         """
         import jax.numpy as jnp
 
@@ -128,10 +135,18 @@ class RawDataset:
         d = self.shard_dims[shard]
         if layout == "auto":
             layout = "dense" if d <= 4096 else "ell"
+        if feature_dtype is not None and layout != "dense":
+            raise ValueError(
+                f"feature_dtype is only supported on the dense layout "
+                f"(got layout={layout!r})"
+            )
         if layout == "dense":
             x = np.zeros((self.n_rows, d), dtype=np.float64)
             x[rows, cols] = vals
-            return batch_from_dense(x, self.labels, self.offsets, self.weights, dtype=dtype)
+            return batch_from_dense(
+                x, self.labels, self.offsets, self.weights, dtype=dtype,
+                feature_dtype=feature_dtype,
+            )
         if layout in ("ell", "sparse", "coo"):
             return batch_from_coo(
                 rows, cols, vals, self.labels, d, self.offsets, self.weights,

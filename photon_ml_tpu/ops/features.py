@@ -214,10 +214,15 @@ def batch_from_dense(
     offsets: Optional[np.ndarray] = None,
     weights: Optional[np.ndarray] = None,
     dtype=jnp.float32,
+    feature_dtype=None,
 ) -> LabeledBatch:
+    """``feature_dtype`` (e.g. bfloat16) stores ONLY the feature matrix in a
+    narrower type — labels/offsets/weights and all solver state stay
+    ``dtype``. On TPU a bf16 X halves the HBM traffic of the bandwidth-bound
+    dense objective sweeps (MXU-native bf16xbf16->f32)."""
     n, d = x.shape
     return LabeledBatch(
-        features=FeatureMatrix(dim=d, dense=jnp.asarray(x, dtype)),
+        features=FeatureMatrix(dim=d, dense=jnp.asarray(x, feature_dtype or dtype)),
         labels=jnp.asarray(y, dtype),
         offsets=jnp.zeros(n, dtype) if offsets is None else jnp.asarray(offsets, dtype),
         weights=jnp.ones(n, dtype) if weights is None else jnp.asarray(weights, dtype),

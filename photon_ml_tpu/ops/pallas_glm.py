@@ -64,23 +64,34 @@ Array = jax.Array
 # Lane width: the feature dim must be a multiple (MXU/VPU tile constraint).
 LANE = 128
 # Per-tile VMEM budget for the X block (bytes); Mosaic double-buffers input
-# blocks, so the steady-state footprint is ~2x this.
-_X_TILE_BYTES = 4 * 1024 * 1024
+# blocks, and the f32 path's Precision.HIGHEST dots need multi-pass scratch
+# proportional to the tile, so f32 runs at half the bf16 budget (a 4MB f32
+# tile OOMs scoped VMEM at HIGHEST — measured).
+_X_TILE_BYTES_BF16 = 4 * 1024 * 1024
+_X_TILE_BYTES_F32 = 2 * 1024 * 1024
 _MAX_TILE_ROWS = 2048
+# row tiles are also the LANE dim of the [1, tn] label/offset/weight blocks,
+# which Mosaic requires to be a multiple of 128
 _MIN_TILE_ROWS = 128
-# VMEM ceiling on the feature dim: the [1, d] coefficient/gradient rows and
-# the (TILE_N, d) X block must fit comfortably.
-MAX_FUSED_DIM = 8192
+# VMEM ceiling on the feature dim: the (tile, d) X block at the MINIMUM tile
+# of 128 rows must fit the dtype budget (f32 additionally pays the
+# Precision.HIGHEST multi-pass scratch — a 4MB f32 tile OOMs scoped VMEM).
+MAX_FUSED_DIM_F32 = 4096
+MAX_FUSED_DIM_BF16 = 8192
 # Below this many rows the dispatch overhead beats the saved HBM sweep.
 MIN_FUSED_ROWS = 4096
 
 
-def tile_rows(d: int) -> int:
-    """Row-tile size for feature dim d: fill the VMEM budget, stay in
-    [128, 2048], multiple of 8 (f32 sublane)."""
-    rows = _X_TILE_BYTES // (4 * max(d, 1))
+def tile_rows(d: int, itemsize: int = 4) -> int:
+    """Row-tile size for feature dim d at the X dtype's ``itemsize``: fill
+    the dtype's VMEM budget, stay in [128, 2048], multiple of 128 (the
+    [1, tn] per-row blocks use tn as their LANE dim, which Mosaic requires
+    to be a multiple of 128; that also covers the f32 (8, 128) and bf16
+    (16, 128) sublane constraints on the X block)."""
+    budget = _X_TILE_BYTES_BF16 if itemsize == 2 else _X_TILE_BYTES_F32
+    rows = budget // (itemsize * max(d, 1))
     rows = max(_MIN_TILE_ROWS, min(_MAX_TILE_ROWS, rows))
-    return (rows // 8) * 8
+    return (rows // 128) * 128
 
 
 def mode() -> str:
@@ -96,13 +107,34 @@ def eligible(n_rows: int, dim: int, dtype) -> bool:
     """Shape/dtype eligibility for the fused kernels. Any row count works
     (partial last tile is masked in-kernel); n_rows only gates the
     worthwhile-at-all threshold."""
+    dt = jnp.dtype(dtype)
+    if dt == jnp.dtype(jnp.bfloat16):
+        max_dim = MAX_FUSED_DIM_BF16
+    elif dt == jnp.dtype(jnp.float32):
+        max_dim = MAX_FUSED_DIM_F32
+    else:
+        return False
     return (
         dim >= LANE
         and dim % LANE == 0
-        and dim <= MAX_FUSED_DIM
+        and dim <= max_dim
         and n_rows >= MIN_FUSED_ROWS
-        and jnp.dtype(dtype) == jnp.float32
     )
+
+
+def _dot_precision(x_dtype):
+    """f32 X -> Precision.HIGHEST: Mosaic's DEFAULT lowers f32 dot operands
+    to a SINGLE bf16 MXU pass (measured: f32 and bf16 X produced bit-identical
+    results under the default — a silent drop to bf16 input precision,
+    ~2.6e-3 relative gradient error), while XLA's jnp GEMV path keeps full
+    f32. HIGHEST restores exact-f32 passes (measured 1.1e-6 gradient
+    agreement with the jnp path, ~1.45x the DEFAULT kernel time — still
+    faster than the two-sweep jnp path). A bf16 X keeps DEFAULT: bf16 is the
+    MXU's native single-pass input type, and bf16 storage is the explicit
+    opt-in fast path."""
+    if x_dtype == jnp.bfloat16:
+        return jax.lax.Precision.DEFAULT
+    return jax.lax.Precision.HIGHEST
 
 
 def _load_tile(rem: int, tn: int, masked: bool, x_ref, y_ref, off_ref, wt_ref):
@@ -118,10 +150,11 @@ def _load_tile(rem: int, tn: int, masked: bool, x_ref, y_ref, off_ref, wt_ref):
         return x_ref[...], y_ref[...], off_ref[...], wt_ref[...]
     lane = jax.lax.broadcasted_iota(jnp.int32, (1, tn), 1) < rem
     sub = jax.lax.broadcasted_iota(jnp.int32, (tn, 1), 0) < rem
-    x = jnp.where(sub, x_ref[...], 0.0)  # [TN, d]
-    y = jnp.where(lane, y_ref[...], 0.0)  # [1, TN]
-    off = jnp.where(lane, off_ref[...], 0.0)
-    wt = jnp.where(lane, wt_ref[...], 0.0)
+    # typed zeros: a python 0.0 would silently promote a bf16 x tile to f32
+    x = jnp.where(sub, x_ref[...], jnp.zeros((), x_ref.dtype))  # [TN, d]
+    y = jnp.where(lane, y_ref[...], jnp.zeros((), y_ref.dtype))  # [1, TN]
+    off = jnp.where(lane, off_ref[...], jnp.zeros((), off_ref.dtype))
+    wt = jnp.where(lane, wt_ref[...], jnp.zeros((), wt_ref.dtype))
     return x, y, off, wt
 
 
@@ -138,17 +171,21 @@ def _vg_kernel(loss: PointwiseLoss, n: int, tn: int, x_ref, coef_ref, y_ref,
     def accumulate(masked):
         x, y, off, wt = _load_tile(n % tn, tn, masked, x_ref, y_ref, off_ref, wt_ref)
         # z^T = coef[1,d] . x^T -> [1, TN]: margins for this row tile
+        prec = _dot_precision(x.dtype)
         z = jax.lax.dot_general(
             coef_ref[...], x, (((1,), (1,)), ((), ())),
-            preferred_element_type=jnp.float32,
+            preferred_element_type=jnp.float32, precision=prec,
         ) + off
         l, dz = loss.loss_and_dz(z, y)
-        wdz = wt * dz  # [1, TN]
+        wdz = wt * dz  # [1, TN] f32
         loss_ref[...] += jnp.sum(wt * l).reshape(1, 1)
         wdz_ref[...] += jnp.sum(wdz).reshape(1, 1)
-        # grad += wdz[1,TN] . x[TN,d] -> [1, d]
+        # grad += wdz[1,TN] . x[TN,d] -> [1, d]; on a bf16 X the per-sample
+        # weighted dz rounds to bf16 too (MXU-native bf16xbf16->f32), the
+        # accumulation stays f32
         grad_ref[...] += jax.lax.dot_general(
-            wdz, x, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32
+            wdz.astype(x.dtype), x, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32, precision=prec,
         )
 
     if n % tn == 0:
@@ -170,18 +207,20 @@ def _hv_kernel(loss: PointwiseLoss, n: int, tn: int, x_ref, coef_ref, v_ref,
 
     def accumulate(masked):
         x, y, off, wt = _load_tile(n % tn, tn, masked, x_ref, y_ref, off_ref, wt_ref)
+        prec = _dot_precision(x.dtype)
         z = jax.lax.dot_general(
             coef_ref[...], x, (((1,), (1,)), ((), ())),
-            preferred_element_type=jnp.float32,
+            preferred_element_type=jnp.float32, precision=prec,
         ) + off
         u = jax.lax.dot_general(
             v_ref[...], x, (((1,), (1,)), ((), ())),
-            preferred_element_type=jnp.float32,
+            preferred_element_type=jnp.float32, precision=prec,
         ) + vshift_ref[...]
-        cu = wt * loss.d2z(z, y) * u  # [1, TN]
+        cu = wt * loss.d2z(z, y) * u  # [1, TN] f32
         csum_ref[...] += jnp.sum(cu).reshape(1, 1)
         hv_ref[...] += jax.lax.dot_general(
-            cu, x, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32
+            cu.astype(x.dtype), x, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32, precision=prec,
         )
 
     if n % tn == 0:
@@ -215,11 +254,13 @@ def fused_value_grad(
     """One-sweep (sum_i wt_i l_i, X^T(wt*dz), sum_i wt_i dz_i) over dense X.
 
     ``offsets`` must already include the normalization margin shift. Any row
-    count works: the last (partial) tile is select-masked in-kernel.
+    count works: the last (partial) tile is select-masked in-kernel. A bf16
+    X runs the MXU-native bf16xbf16->f32 path (coefficients round to bf16 at
+    the dot inputs; every accumulator and output stays f32).
     """
     n, d = x.shape
-    tn = tile_rows(d)
-    dt = x.dtype
+    tn = tile_rows(d, jnp.dtype(x.dtype).itemsize)
+    out_dt = jnp.float32 if x.dtype == jnp.bfloat16 else x.dtype
     x_spec, d_spec, n_spec, out_d, out_s = _row_specs(tn, d)
     loss_sum, grad, wdz_sum = pl.pallas_call(
         functools.partial(_vg_kernel, loss, n, tn),
@@ -227,17 +268,17 @@ def fused_value_grad(
         in_specs=[x_spec, d_spec, n_spec, n_spec, n_spec],
         out_specs=[out_s, out_d, out_s],
         out_shape=[
-            jax.ShapeDtypeStruct((1, 1), dt),
-            jax.ShapeDtypeStruct((1, d), dt),
-            jax.ShapeDtypeStruct((1, 1), dt),
+            jax.ShapeDtypeStruct((1, 1), out_dt),
+            jax.ShapeDtypeStruct((1, d), out_dt),
+            jax.ShapeDtypeStruct((1, 1), out_dt),
         ],
         interpret=interpret,
     )(
         x,
-        eff_coef.reshape(1, d),
-        labels.reshape(1, n),
-        offsets.reshape(1, n),
-        weights.reshape(1, n),
+        eff_coef.astype(x.dtype).reshape(1, d),
+        labels.astype(out_dt).reshape(1, n),
+        offsets.astype(out_dt).reshape(1, n),
+        weights.astype(out_dt).reshape(1, n),
     )
     return loss_sum[0, 0], grad[0], wdz_sum[0, 0]
 
@@ -324,7 +365,8 @@ def sharded_hessian_vector(
         ),
         out_specs=(P(), P()),
         check_vma=False,
-    )(x, eff_coef, eff_v, labels, offsets, weights, jnp.asarray(vshift, x.dtype))
+    )(x, eff_coef, eff_v, labels, offsets, weights,
+      jnp.asarray(vshift, jnp.float32))
 
 
 @functools.partial(jax.jit, static_argnames=("loss", "interpret"))
@@ -345,8 +387,8 @@ def fused_hessian_vector(
     dense X — the TRON CG inner-loop op.
     """
     n, d = x.shape
-    tn = tile_rows(d)
-    dt = x.dtype
+    tn = tile_rows(d, jnp.dtype(x.dtype).itemsize)
+    out_dt = jnp.float32 if x.dtype == jnp.bfloat16 else x.dtype
     x_spec, d_spec, n_spec, out_d, out_s = _row_specs(tn, d)
     hv, csum = pl.pallas_call(
         functools.partial(_hv_kernel, loss, n, tn),
@@ -354,17 +396,17 @@ def fused_hessian_vector(
         in_specs=[x_spec, d_spec, d_spec, n_spec, n_spec, n_spec, out_s],
         out_specs=[out_d, out_s],
         out_shape=[
-            jax.ShapeDtypeStruct((1, d), dt),
-            jax.ShapeDtypeStruct((1, 1), dt),
+            jax.ShapeDtypeStruct((1, d), out_dt),
+            jax.ShapeDtypeStruct((1, 1), out_dt),
         ],
         interpret=interpret,
     )(
         x,
-        eff_coef.reshape(1, d),
-        eff_v.reshape(1, d),
-        labels.reshape(1, n),
-        offsets.reshape(1, n),
-        weights.reshape(1, n),
-        jnp.asarray(vshift, dt).reshape(1, 1),
+        eff_coef.astype(x.dtype).reshape(1, d),
+        eff_v.astype(x.dtype).reshape(1, d),
+        labels.astype(out_dt).reshape(1, n),
+        offsets.astype(out_dt).reshape(1, n),
+        weights.astype(out_dt).reshape(1, n),
+        jnp.asarray(vshift, out_dt).reshape(1, 1),
     )
     return hv[0], csum[0, 0]

@@ -418,19 +418,46 @@ def test_checkpoint_tuning_resume(avro_paths, tmp_path, monkeypatch):
     assert len(summary["configs"]) == 4
 
 
-def test_full_variance_on_tiled_refused_early(avro_paths, tmp_path):
-    """variance=FULL + layout=tiled must fail at configuration time with a
-    clear message, not as a NotImplementedError deep inside training
-    (round-3 verdict missing item 5)."""
+def test_full_variance_on_tiled_works_and_ceiling_fails_early(avro_paths, tmp_path):
+    """variance=FULL on layout=tiled is SUPPORTED (chunked sharded xtcx,
+    round-3 verdict missing item 5 upgraded from 'refuse clearly' to
+    'implement'); beyond the d ceiling it fails BEFORE the solve with a
+    clear ValueError, not a deep NotImplementedError."""
     train_p, _ = avro_paths
-    with pytest.raises((SystemExit, ValueError), match="variance=FULL"):
-        train.run([
-            "--input-data", train_p,
-            "--task", "logistic_regression",
-            "--feature-shard", "name=globalShard,bags=features",
-            "--coordinate",
-            "name=global,shard=globalShard,layout=tiled,variance=FULL,"
-            "reg.type=L2,reg.weights=1",
-            "--mesh-shape", "data=4,model=2",
-            "--output-dir", str(tmp_path / "out"),
-        ])
+    summary = train.run([
+        "--input-data", train_p,
+        "--task", "logistic_regression",
+        "--feature-shard", "name=globalShard,bags=features",
+        "--coordinate",
+        "name=global,shard=globalShard,layout=tiled,variance=FULL,"
+        "reg.type=L2,reg.weights=1",
+        "--mesh-shape", "data=4,model=2",
+        "--output-dir", str(tmp_path / "out"),
+    ])
+    assert summary["configs"]
+
+    # over-ceiling d: the check fires in GLMProblem.run BEFORE optimize()
+    import jax.numpy as jnp
+    from photon_ml_tpu.game.problem import GLMOptimizationConfig, GLMProblem
+    from photon_ml_tpu.optimize import OptimizerConfig
+    from photon_ml_tpu.parallel import make_mesh
+    from photon_ml_tpu.parallel.sparse import tiled_sparse_batch
+
+    n, big_d = 64, 10_000
+    rng = np.random.default_rng(0)
+    rows = np.repeat(np.arange(n), 2)
+    cols = rng.integers(0, big_d, 2 * n)
+    vals = rng.normal(size=2 * n)
+    y = (rng.random(n) > 0.5).astype(np.float64)
+    tb = tiled_sparse_batch(
+        rows, cols, vals, y, big_d, make_mesh(n_data=4, n_model=2),
+        dtype=jnp.float64,
+    )
+    prob = GLMProblem(
+        task="logistic_regression",
+        config=GLMOptimizationConfig(
+            optimizer=OptimizerConfig(), variance_type="FULL"
+        ),
+    )
+    with pytest.raises(ValueError, match="variance=FULL"):
+        prob.run(tb)
