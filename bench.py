@@ -86,10 +86,11 @@ def build_data(n=500_000, d_fixed=1024, n_users=20_000, d_re=32, seed=0):
     return gx, y, ex, ids
 
 
-def _glmix_datasets(gx, y, ex, ids):
+def _glmix_datasets(gx, y, ex, ids, feature_dtype=None):
     """Product-path datasets without the dense-global-COO detour: the fixed
     effect batches the dense matrix directly; the RE build runs the real
-    pipeline on a userShard-only RawDataset."""
+    pipeline on a userShard-only RawDataset. ``feature_dtype`` opts the dense
+    fixed-effect features into bf16 storage (the --feature-dtype flag)."""
     from photon_ml_tpu.game.data import FixedEffectDataset, build_random_effect_dataset
     from photon_ml_tpu.io.data import RawDataset
     from photon_ml_tpu.ops.features import batch_from_dense
@@ -109,7 +110,7 @@ def _glmix_datasets(gx, y, ex, ids):
     fe_ds = FixedEffectDataset(
         coordinate_id="global",
         feature_shard="global",
-        batch=batch_from_dense(gx, y),
+        batch=batch_from_dense(gx, y, feature_dtype=feature_dtype),
         true_dim=gx.shape[1],
         true_n_rows=n,
     )
@@ -122,6 +123,8 @@ def _glmix_datasets(gx, y, ex, ids):
 
 
 def bench_tpu(fe_ds, re_ds, reg=1.0, sweeps=1):
+    import jax.numpy as jnp
+
     from photon_ml_tpu.game import (
         CoordinateDescent,
         FixedEffectCoordinate,
@@ -153,8 +156,11 @@ def bench_tpu(fe_ds, re_ds, reg=1.0, sweeps=1):
             ),
         }
         result = CoordinateDescent(coords, n_iterations=sweeps).run()
-        np.asarray(result.model["per-user"].coef_values)  # block until done
-        np.asarray(result.model["global"].model.coefficients.means)
+        # true sync via scalar fetches (a full-model fetch would bill the
+        # harness's slow host link to the sweep; real deployments read the
+        # model over PCIe once at save time)
+        float(jnp.sum(result.model["per-user"].coef_values))
+        float(jnp.sum(result.model["global"].model.coefficients.means))
         return result
 
     run()  # warmup/compile
@@ -481,6 +487,14 @@ def main():
         help="re-measure the pinned CPU baseline (median of 3) and store it "
         "in BASELINE.json; by default the stored value is used",
     )
+    p.add_argument(
+        "--feature-dtype",
+        choices=["float32", "bfloat16"],
+        default="float32",
+        help="glmix config only: storage dtype of the dense fixed-effect "
+        "feature matrix (bfloat16 = the opt-in half-traffic path; the "
+        "default f32 keeps exact-precision parity with the reference)",
+    )
     a = p.parse_args()
 
     if a.config == "sparse":
@@ -498,7 +512,9 @@ def main():
 
     n = 500_000
     gx, y, ex, ids = build_data(n=n, d_fixed=1024, n_users=20_000, d_re=32)
-    fe_ds, re_ds = _glmix_datasets(gx, y, ex, ids)
+    # jnp.asarray accepts the dtype name directly
+    feature_dtype = None if a.feature_dtype == "float32" else a.feature_dtype
+    fe_ds, re_ds = _glmix_datasets(gx, y, ex, ids, feature_dtype=feature_dtype)
     wall_tpu, _ = bench_tpu(fe_ds, re_ds)
     examples_per_sec = n / wall_tpu
 

@@ -199,32 +199,38 @@ class RandomEffectCoordinate(Coordinate):
         else:
             offsets = blocks.offsets
 
-        # w0/priors as host numpy: valid jit inputs in both single- and
-        # multi-process mode (multi-process: every process holds the full
-        # array; jit treats numpy inputs as replicated contributions)
-        np_dtype = np.dtype(jnp.zeros((), dtype).dtype)
+        # w0/priors: multi-process passes host numpy (every process holds the
+        # full array; jit treats numpy inputs as replicated contributions).
+        # Single-process creates the default zeros/ones ON DEVICE — three
+        # host [E, S] uploads per train call (~7 MB at bench shapes) would
+        # otherwise ride the host->device link every sweep.
+        multiproc = jax.process_count() > 1
+        if multiproc:
+            xp, xdt = np, np.dtype(jnp.zeros((), dtype).dtype)
+            to_host = np.asarray
+        else:
+            xp, xdt = jnp, dtype
+            to_host = lambda a: a  # noqa: E731 — single decision point
         if initial_model is not None:
-            w0 = np.asarray(
+            w0 = to_host(
                 _initial_subspace_coefficients(self.dataset, initial_model, dtype)
             )
         else:
-            w0 = np.zeros((E, S), np_dtype)
+            w0 = xp.zeros((E, S), xdt)
 
-        prior_mean = np.zeros((E, S), np_dtype)
-        prior_prec = np.ones((E, S), np_dtype)
+        prior_mean = xp.zeros((E, S), xdt)
+        prior_prec = xp.ones((E, S), xdt)
         if self.prior_model is not None:
-            prior_mean = np.asarray(
+            prior_mean = to_host(
                 _project_model_values(
                     self.dataset, self.prior_model, self.prior_model.coef_values, dtype
                 )
             )
             if self.prior_model.variances is not None:
-                var = np.asarray(
-                    _project_model_values(
-                        self.dataset, self.prior_model, self.prior_model.variances, dtype
-                    )
+                var = _project_model_values(
+                    self.dataset, self.prior_model, self.prior_model.variances, dtype
                 )
-                prior_prec = 1.0 / np.maximum(var, 1e-12)
+                prior_prec = to_host(1.0 / jnp.maximum(var, 1e-12))
 
         cfg = self.config
         solver_cfg = cfg.solver_config()
