@@ -187,12 +187,25 @@ class GLMObjective:
         variance = 1/diag, DistributedOptimizationProblem.scala:84-108)."""
         b = self.batch
         norm = self._norm()
-        c = self._d2z_weights(coef)
-        s2 = b.features.sq_rmatvec(c)
+        need_shifts = norm.shifts is not None
+        if self.fused is not None and b.features.is_dense:
+            # one X sweep for (s2[, s1, s0]) instead of up to three
+            from .pallas_glm import sharded_hessian_stats
+
+            eff, mshift = norm.effective_coefficients(coef)
+            s2, s1, s0 = sharded_hessian_stats(
+                self.fused_mesh, b.features.dense, eff, b.labels,
+                b.offsets + mshift, b.weights, self.loss,
+                interpret=(self.fused == "interpret"),
+                need_shifts=need_shifts,
+            )
+        else:
+            c = self._d2z_weights(coef)
+            s2 = b.features.sq_rmatvec(c)
+            s1 = b.features.rmatvec(c) if need_shifts else None
+            s0 = jnp.sum(c) if need_shifts else None
         diag = s2
-        if norm.shifts is not None:
-            s1 = b.features.rmatvec(c)
-            s0 = jnp.sum(c)
+        if need_shifts:
             diag = s2 - 2.0 * norm.shifts * s1 + norm.shifts**2 * s0
         if norm.factors is not None:
             diag = diag * norm.factors**2

@@ -82,13 +82,15 @@ MAX_FUSED_DIM_BF16 = 8192
 MIN_FUSED_ROWS = 4096
 
 
-def tile_rows(d: int, itemsize: int = 4) -> int:
+def tile_rows(d: int, itemsize: int = 4, parts: int = 1) -> int:
     """Row-tile size for feature dim d at the X dtype's ``itemsize``: fill
     the dtype's VMEM budget, stay in [128, 2048], multiple of 128 (the
     [1, tn] per-row blocks use tn as their LANE dim, which Mosaic requires
     to be a multiple of 128; that also covers the f32 (8, 128) and bf16
-    (16, 128) sublane constraints on the X block)."""
-    budget = _X_TILE_BYTES_BF16 if itemsize == 2 else _X_TILE_BYTES_F32
+    (16, 128) sublane constraints on the X block). ``parts`` divides the
+    budget for kernels holding extra tile-sized temporaries (the
+    Hessian-stats kernel materializes x*x alongside x)."""
+    budget = (_X_TILE_BYTES_BF16 if itemsize == 2 else _X_TILE_BYTES_F32) // parts
     rows = budget // (itemsize * max(d, 1))
     rows = max(_MIN_TILE_ROWS, min(_MAX_TILE_ROWS, rows))
     return (rows // 128) * 128
@@ -222,6 +224,46 @@ def _hv_kernel(loss: PointwiseLoss, n: int, tn: int, x_ref, coef_ref, v_ref,
             cu.astype(x.dtype), x, (((1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32, precision=prec,
         )
+
+    if n % tn == 0:
+        accumulate(False)
+    else:
+        last = pl.cdiv(n, tn) - 1
+        pl.when(i < last)(lambda: accumulate(False))
+        pl.when(i == last)(lambda: accumulate(True))
+
+
+def _hd_kernel(loss: PointwiseLoss, n: int, tn: int, need_shifts: bool,
+               x_ref, coef_ref, y_ref, off_ref, wt_ref, s2_ref, *shift_refs):
+    i = pl.program_id(0)
+
+    @pl.when(i == 0)
+    def _():
+        s2_ref[...] = jnp.zeros_like(s2_ref)
+        for r in shift_refs:
+            r[...] = jnp.zeros_like(r)
+
+    def accumulate(masked):
+        x, y, off, wt = _load_tile(n % tn, tn, masked, x_ref, y_ref, off_ref, wt_ref)
+        prec = _dot_precision(x.dtype)
+        z = jax.lax.dot_general(
+            coef_ref[...], x, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32, precision=prec,
+        ) + off
+        c = wt * loss.d2z(z, y)  # [1, TN] f32
+        cx = c.astype(x.dtype)
+        # s2 += c . (x*x): square in-register, same single HBM sweep
+        s2_ref[...] += jax.lax.dot_general(
+            cx, x * x, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32, precision=prec,
+        )
+        if need_shifts:  # static: unnormalized models skip the s1 dot
+            s1_ref, s0_ref = shift_refs
+            s1_ref[...] += jax.lax.dot_general(
+                cx, x, (((1,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32, precision=prec,
+            )
+            s0_ref[...] += jnp.sum(c).reshape(1, 1)
 
     if n % tn == 0:
         accumulate(False)
@@ -410,3 +452,96 @@ def fused_hessian_vector(
         jnp.asarray(vshift, out_dt).reshape(1, 1),
     )
     return hv[0], csum[0, 0]
+
+
+@functools.partial(jax.jit, static_argnames=("loss", "interpret", "need_shifts"))
+def fused_hessian_stats(
+    x: Array,
+    eff_coef: Array,
+    labels: Array,
+    offsets: Array,
+    weights: Array,
+    loss: PointwiseLoss,
+    interpret: bool = False,
+    need_shifts: bool = False,
+) -> Tuple[Array, Array, Array]:
+    """One-sweep Hessian-diagonal aggregates with c = wt*l''(z):
+
+        s2 = (x*x)^T c,   and with ``need_shifts``: s1 = x^T c, s0 = sum c
+
+    — everything GLMObjective.hessian_diagonal needs (s1/s0 only under
+    normalization shifts; without them the extra dot is skipped statically),
+    replacing up to three X sweeps (z, sq_rmatvec, rmatvec) with one.
+    ``offsets`` must already include the margin shift. Returns
+    (s2, s1-or-None, s0-or-None). The tile budget is halved (parts=2): the
+    kernel holds an x*x temporary alongside the x tile.
+    """
+    n, d = x.shape
+    tn = tile_rows(d, jnp.dtype(x.dtype).itemsize, parts=2)
+    out_dt = jnp.float32 if x.dtype == jnp.bfloat16 else x.dtype
+    x_spec, d_spec, n_spec, out_d, out_s = _row_specs(tn, d)
+    out_specs = [out_d] + ([out_d, out_s] if need_shifts else [])
+    out_shape = [jax.ShapeDtypeStruct((1, d), out_dt)] + (
+        [jax.ShapeDtypeStruct((1, d), out_dt), jax.ShapeDtypeStruct((1, 1), out_dt)]
+        if need_shifts
+        else []
+    )
+    outs = pl.pallas_call(
+        functools.partial(_hd_kernel, loss, n, tn, need_shifts),
+        grid=(pl.cdiv(n, tn),),
+        in_specs=[x_spec, d_spec, n_spec, n_spec, n_spec],
+        out_specs=out_specs,
+        out_shape=out_shape,
+        interpret=interpret,
+    )(
+        x,
+        eff_coef.astype(x.dtype).reshape(1, d),
+        labels.astype(out_dt).reshape(1, n),
+        offsets.astype(out_dt).reshape(1, n),
+        weights.astype(out_dt).reshape(1, n),
+    )
+    if need_shifts:
+        s2, s1, s0 = outs
+        return s2[0], s1[0], s0[0, 0]
+    return outs[0][0], None, None
+
+
+def sharded_hessian_stats(
+    mesh,
+    x: Array,
+    eff_coef: Array,
+    labels: Array,
+    offsets: Array,
+    weights: Array,
+    loss: PointwiseLoss,
+    interpret: bool = False,
+    need_shifts: bool = False,
+) -> Tuple[Array, Array, Array]:
+    """fused_hessian_stats over a DATA-axis-sharded batch (see
+    sharded_value_grad). mesh=None delegates to the single-device kernel."""
+    if mesh is None:
+        return fused_hessian_stats(
+            x, eff_coef, labels, offsets, weights, loss,
+            interpret=interpret, need_shifts=need_shifts,
+        )
+    from jax import shard_map
+    from jax.sharding import PartitionSpec as P
+
+    from ..parallel.mesh import DATA_AXIS
+
+    def f(x_l, eff_l, y_l, off_l, wt_l):
+        outs = fused_hessian_stats(
+            x_l, eff_l, y_l, off_l, wt_l, loss,
+            interpret=interpret, need_shifts=need_shifts,
+        )
+        return tuple(jax.lax.psum(o, DATA_AXIS) for o in outs if o is not None)
+
+    n_out = 3 if need_shifts else 1
+    outs = shard_map(
+        f,
+        mesh=mesh,
+        in_specs=(P(DATA_AXIS, None), P(), P(DATA_AXIS), P(DATA_AXIS), P(DATA_AXIS)),
+        out_specs=tuple([P()] * n_out),
+        check_vma=False,
+    )(x, eff_coef, labels, offsets, weights)
+    return outs + (None,) * (3 - n_out)

@@ -74,6 +74,10 @@ def test_fused_value_grad_and_hv_parity(rng, loss_name, with_norm):
     h1 = np.asarray(fused.hessian_vector(w, v))
     assert np.max(np.abs(h1 - h0)) <= 3e-5 * max(np.max(np.abs(h0)), 1.0)
 
+    d0 = np.asarray(base.hessian_diagonal(w))
+    d1 = np.asarray(fused.hessian_diagonal(w))
+    assert np.max(np.abs(d1 - d0)) <= 3e-5 * max(np.max(np.abs(d0)), 1.0)
+
 
 def test_fused_under_jit_and_partial_tile(rng):
     """The fused objective must jit (solvers trace it), handle a row count
@@ -174,6 +178,38 @@ def test_sharded_fused_matches_unsharded(rng, monkeypatch):
     h0 = np.asarray(base.hessian_vector(w, v))
     h1 = np.asarray(fused.hessian_vector(w, v))
     assert np.max(np.abs(h1 - h0)) <= 3e-5 * max(np.max(np.abs(h0)), 1.0)
+
+    d0 = np.asarray(base.hessian_diagonal(w))
+    d1 = np.asarray(fused.hessian_diagonal(w))
+    assert np.max(np.abs(d1 - d0)) <= 3e-5 * max(np.max(np.abs(d0)), 1.0)
+
+    # the shifts path of the sharded stats kernel (s1/s0 psums)
+    norm = _norm_ctx(rng)
+    base_n = dataclasses.replace(base, norm=norm)
+    fused_n = dataclasses.replace(fused, norm=norm)
+    dn0 = np.asarray(base_n.hessian_diagonal(w))
+    dn1 = np.asarray(fused_n.hessian_diagonal(w))
+    assert np.max(np.abs(dn1 - dn0)) <= 3e-5 * max(np.max(np.abs(dn0)), 1.0)
+
+
+@pytest.mark.parametrize("d", [128, 384, 1024])
+@pytest.mark.parametrize("n_off", [0, 1, 127])
+def test_kernel_shape_sweep(rng, d, n_off):
+    """Property sweep over feature dims and row remainders (full tiles,
+    off-by-one, near-full partial tile): fused value+grad must match the jnp
+    path at every shape the gating can admit."""
+    n = pallas_glm.tile_rows(d) * 2 + n_off
+    x = (rng.standard_normal((n, d)) * 0.4).astype(np.float32)
+    y = (rng.random(n) > 0.5).astype(np.float32)
+    batch = batch_from_dense(x, y)
+    base = GLMObjective(loss=LOSSES["logistic"], batch=batch, l2=0.1)
+    fused = dataclasses.replace(base, fused="interpret")
+    w = jnp.asarray((rng.standard_normal(d) * 0.1).astype(np.float32))
+    v0, g0 = base.value_and_grad(w)
+    v1, g1 = fused.value_and_grad(w)
+    np.testing.assert_allclose(float(v1), float(v0), rtol=2e-6)
+    g0, g1 = np.asarray(g0), np.asarray(g1)
+    assert np.max(np.abs(g1 - g0)) <= 3e-5 * max(np.max(np.abs(g0)), 1.0)
 
 
 def test_end_to_end_sharded_solve(rng, monkeypatch):
