@@ -201,11 +201,15 @@ class RandomEffectCoordinate(Coordinate):
 
         # w0/priors: multi-process passes host numpy (every process holds the
         # full array; jit treats numpy inputs as replicated contributions).
-        # Single-process creates the default zeros/ones ON DEVICE — three
-        # host [E, S] uploads per train call (~7 MB at bench shapes) would
-        # otherwise ride the host->device link every sweep.
+        # Single-process on an ACCELERATOR creates the default zeros/ones ON
+        # DEVICE — three host [E, S] uploads per train call (~7 MB at bench
+        # shapes) would otherwise ride the host->device link every sweep. On
+        # the CPU backend host numpy is kept: the transfer is a memcpy, and
+        # device-created inputs to the sharded-blocks pjit tickled an XLA:CPU
+        # compiler segfault under long test sessions (observed at
+        # test_scale_paths with 8 virtual devices).
         multiproc = jax.process_count() > 1
-        if multiproc:
+        if multiproc or jax.default_backend() == "cpu":
             xp, xdt = np, np.dtype(jnp.zeros((), dtype).dtype)
             to_host = np.asarray
         else:

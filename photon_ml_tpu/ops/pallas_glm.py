@@ -25,7 +25,9 @@ costs THREE X sweeps per call (z for the curvature weights, u = X v, and the
 transpose accumulation), and it is the inner-loop op of TRON's conjugate
 gradient (optimize/tron.py:85). Every per-row quantity (z_i, u_i, c_i) depends
 only on row i, so the fused kernel computes all three in one sweep — 3x per
-CG iteration, no caching or solver changes needed.
+CG iteration, no caching or solver changes needed. The Hessian-diagonal
+aggregates for SIMPLE variances (s2 = (x*x)^T c, plus s1/s0 under
+normalization shifts) get the same one-sweep treatment (_hd_kernel).
 
 Reference parity: these kernels compute exactly the RAW aggregates of the
 reference's ValueAndGradientAggregator / HessianVectorAggregator
@@ -325,6 +327,34 @@ def fused_value_grad(
     return loss_sum[0, 0], grad[0], wdz_sum[0, 0]
 
 
+def _shard_psum_call(mesh, inner, rep_mask, n_out, args):
+    """Shared shell of the sharded_* wrappers: run ``inner`` per data shard
+    under shard_map and psum each of its ``n_out`` outputs over the data axis
+    (pallas_call has no GSPMD partitioning rule, so collective placement is
+    explicit). ``rep_mask[i]`` marks argument i replicated; non-replicated
+    args are row-sharded (arg 0 is the 2-D X, the rest are [n] vectors)."""
+    from jax import shard_map
+    from jax.sharding import PartitionSpec as P
+
+    from ..parallel.mesh import DATA_AXIS  # lazy: parallel imports ops
+
+    def g(*a):
+        return tuple(jax.lax.psum(o, DATA_AXIS) for o in inner(*a))
+
+    in_specs = tuple(
+        P() if rep else (P(DATA_AXIS, None) if i == 0 else P(DATA_AXIS))
+        for i, rep in enumerate(rep_mask)
+    )
+    return shard_map(
+        g,
+        mesh=mesh,
+        in_specs=in_specs,
+        out_specs=(P(),) * n_out,
+        # pallas_call cannot annotate vma on its out_shape structs
+        check_vma=False,
+    )(*args)
+
+
 def sharded_value_grad(
     mesh,
     x: Array,
@@ -337,35 +367,21 @@ def sharded_value_grad(
 ) -> Tuple[Array, Array, Array]:
     """fused_value_grad over a DATA-axis-sharded batch: each device sweeps its
     own row shard with the Pallas kernel, the three raw aggregates psum over
-    the data axis (the reference's treeAggregate, SURVEY.md P1 — here an
-    explicit shard_map because pallas_call has no GSPMD partitioning rule).
+    the data axis (the reference's treeAggregate, SURVEY.md P1).
     mesh=None delegates to the single-device kernel, so callers keep ONE call
     site for both placements."""
     if mesh is None:
         return fused_value_grad(
             x, eff_coef, labels, offsets, weights, loss, interpret=interpret
         )
-    from jax import shard_map
-    from jax.sharding import PartitionSpec as P
 
-    from ..parallel.mesh import DATA_AXIS  # lazy: parallel imports ops
+    def inner(x_l, eff_l, y_l, off_l, wt_l):
+        return fused_value_grad(x_l, eff_l, y_l, off_l, wt_l, loss, interpret=interpret)
 
-    def f(x_l, eff_l, y_l, off_l, wt_l):
-        ls, g, ws = fused_value_grad(x_l, eff_l, y_l, off_l, wt_l, loss, interpret)
-        return (
-            jax.lax.psum(ls, DATA_AXIS),
-            jax.lax.psum(g, DATA_AXIS),
-            jax.lax.psum(ws, DATA_AXIS),
-        )
-
-    return shard_map(
-        f,
-        mesh=mesh,
-        in_specs=(P(DATA_AXIS, None), P(), P(DATA_AXIS), P(DATA_AXIS), P(DATA_AXIS)),
-        out_specs=(P(), P(), P()),
-        # pallas_call cannot annotate vma on its out_shape structs
-        check_vma=False,
-    )(x, eff_coef, labels, offsets, weights)
+    return _shard_psum_call(
+        mesh, inner, (False, True, False, False, False), 3,
+        (x, eff_coef, labels, offsets, weights),
+    )
 
 
 def sharded_hessian_vector(
@@ -387,28 +403,17 @@ def sharded_hessian_vector(
             x, eff_coef, eff_v, labels, offsets, weights, vshift, loss,
             interpret=interpret,
         )
-    from jax import shard_map
-    from jax.sharding import PartitionSpec as P
 
-    from ..parallel.mesh import DATA_AXIS
-
-    def f(x_l, eff_l, v_l, y_l, off_l, wt_l, vs_l):
-        hv, cs = fused_hessian_vector(
-            x_l, eff_l, v_l, y_l, off_l, wt_l, vs_l, loss, interpret
+    def inner(x_l, eff_l, v_l, y_l, off_l, wt_l, vs_l):
+        return fused_hessian_vector(
+            x_l, eff_l, v_l, y_l, off_l, wt_l, vs_l, loss, interpret=interpret
         )
-        return jax.lax.psum(hv, DATA_AXIS), jax.lax.psum(cs, DATA_AXIS)
 
-    return shard_map(
-        f,
-        mesh=mesh,
-        in_specs=(
-            P(DATA_AXIS, None), P(), P(), P(DATA_AXIS), P(DATA_AXIS),
-            P(DATA_AXIS), P(),
-        ),
-        out_specs=(P(), P()),
-        check_vma=False,
-    )(x, eff_coef, eff_v, labels, offsets, weights,
-      jnp.asarray(vshift, jnp.float32))
+    return _shard_psum_call(
+        mesh, inner, (False, True, True, False, False, False, True), 2,
+        (x, eff_coef, eff_v, labels, offsets, weights,
+         jnp.asarray(vshift, jnp.float32)),
+    )
 
 
 @functools.partial(jax.jit, static_argnames=("loss", "interpret"))
@@ -524,24 +529,17 @@ def sharded_hessian_stats(
             x, eff_coef, labels, offsets, weights, loss,
             interpret=interpret, need_shifts=need_shifts,
         )
-    from jax import shard_map
-    from jax.sharding import PartitionSpec as P
 
-    from ..parallel.mesh import DATA_AXIS
-
-    def f(x_l, eff_l, y_l, off_l, wt_l):
+    def inner(x_l, eff_l, y_l, off_l, wt_l):
         outs = fused_hessian_stats(
             x_l, eff_l, y_l, off_l, wt_l, loss,
             interpret=interpret, need_shifts=need_shifts,
         )
-        return tuple(jax.lax.psum(o, DATA_AXIS) for o in outs if o is not None)
+        return tuple(o for o in outs if o is not None)
 
     n_out = 3 if need_shifts else 1
-    outs = shard_map(
-        f,
-        mesh=mesh,
-        in_specs=(P(DATA_AXIS, None), P(), P(DATA_AXIS), P(DATA_AXIS), P(DATA_AXIS)),
-        out_specs=tuple([P()] * n_out),
-        check_vma=False,
-    )(x, eff_coef, labels, offsets, weights)
+    outs = _shard_psum_call(
+        mesh, inner, (False, True, False, False, False), n_out,
+        (x, eff_coef, labels, offsets, weights),
+    )
     return outs + (None,) * (3 - n_out)

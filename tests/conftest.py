@@ -16,6 +16,12 @@ import os
 # (e.g. JAX_PLATFORMS=axon, which also ignores later env-var edits — the
 # config update below is what actually pins the platform).
 os.environ["JAX_PLATFORMS"] = "cpu"
+# Tests must not share the driver's persistent XLA compilation cache: a
+# cache entry corrupted by a killed process SEGFAULTS jax's cache read
+# (observed: compilation_cache.get_executable_and_time), and test compiles
+# would pollute the production cache anyway. Plain assignment — a developer's
+# exported PHOTON_COMPILE_CACHE must not leak into test runs.
+os.environ["PHOTON_COMPILE_CACHE"] = "0"
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (

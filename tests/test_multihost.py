@@ -678,3 +678,121 @@ def test_two_process_tiled_matches_single_process(tmp_path):
         ).models["global"].coefficients.means
     )
     np.testing.assert_allclose(w_m, w_s, rtol=1e-2, atol=1e-3)
+
+
+_WORKER_F32 = """
+import sys
+import jax
+jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_num_cpu_devices", 4)
+# NO x64: the fused Pallas kernels require f32 batches
+
+from photon_ml_tpu.cli import train
+
+summary = train.run(sys.argv[1:])
+print("WORKER_OK", jax.process_index(), summary["best"]["metrics"]["AUC"])
+
+# prove which objective path the trainer took (guards against the test
+# passing vacuously if gating ever stops admitting multi-process batches)
+import jax.numpy as jnp
+from photon_ml_tpu.io import FeatureShardConfig, read_avro_dataset
+from photon_ml_tpu.io.avro import count_avro_rows
+from photon_ml_tpu.io.index_map import load_partitioned
+from photon_ml_tpu.game.problem import _fusion_mode
+from photon_ml_tpu.parallel import make_mesh, multihost, shard_batch
+
+a = dict(zip(sys.argv[1:], sys.argv[2:]))
+imaps = {"global": load_partitioned(a["--feature-index-dir"], "global")}
+rr = multihost.host_row_range(count_avro_rows(a["--input-data"]))
+ds, _ = read_avro_dataset(
+    a["--input-data"], {"global": FeatureShardConfig(("features",))},
+    index_maps=imaps, row_range=rr)
+mesh = make_mesh(n_data=8, n_model=1)
+batch = shard_batch(ds.to_batch("global", dtype=jnp.float32), mesh)
+mode, fmesh = _fusion_mode(batch)
+print("FUSIONMODE", mode, "mesh" if fmesh is not None else "nomesh")
+"""
+
+
+@pytest.mark.slow
+def test_two_process_fused_pallas_matches_unfused(tmp_path):
+    """The fused Pallas shard_map path across PROCESSES: a 2-process run at
+    fused-eligible shapes (n >= 4096, d = 128) with PHOTON_PALLAS=interpret
+    must train to the same model as the same 2-process run with fusion off —
+    the per-shard kernel + cross-host psum against the GSPMD jnp path.
+    127 raw features + the shard intercept = d 128 (the fused path needs a
+    lane-width multiple; the FUSIONMODE assertions below guard against this
+    test passing vacuously on the jnp path)."""
+    data = _write_data(tmp_path, n=4608, d=127, seed=11)
+    index_dir = str(tmp_path / "index")
+
+    from photon_ml_tpu.cli import index as index_cli
+
+    common = [
+        "--input-data", data,
+        "--feature-shard", "name=global,bags=features",
+    ]
+    index_cli.run(common + ["--output-dir", index_dir])
+
+    train_common = common + [
+        "--validation-data", data,
+        "--task", "logistic_regression",
+        "--coordinate",
+        "name=global,shard=global,optimizer=LBFGS,tolerance=1e-9,max.iter=60,"
+        "reg.type=L2,reg.weights=1",
+        "--evaluators", "AUC",
+        "--feature-index-dir", index_dir,
+    ]
+
+    models = {}
+    for mode in ("off", "interpret"):
+        out_dir = str(tmp_path / f"out-{mode}")
+        port = _free_port()
+        env = {**os.environ, "PYTHONPATH": REPO, "PHOTON_PALLAS": mode}
+        env.pop("XLA_FLAGS", None)
+        procs = [
+            subprocess.Popen(
+                [
+                    sys.executable, "-c", _WORKER_F32,
+                    *train_common,
+                    "--output-dir", out_dir,
+                    "--mesh-shape", "data=8",
+                    "--distributed",
+                    f"coordinator=localhost:{port},process={i},n=2",
+                ],
+                env=env, cwd=REPO,
+                stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True,
+            )
+            for i in range(2)
+        ]
+        outs = []
+        for p in procs:
+            try:
+                out, err = p.communicate(timeout=420)
+            except subprocess.TimeoutExpired:
+                for q in procs:
+                    q.kill()
+                pytest.fail(f"fused-pallas 2-process run ({mode}) timed out")
+            outs.append((p.returncode, out, err))
+        for rc, out, err in outs:
+            assert rc == 0, f"worker failed ({mode}):\n{out}\n{err}"
+            assert "WORKER_OK" in out
+            # NOT vacuous: the interpret run must have actually fused (with
+            # the cross-host mesh), the off run must not have
+            expected = "FUSIONMODE interpret mesh" if mode == "interpret" else "FUSIONMODE None"
+            assert expected in out, f"({mode}) fusion gating changed:\n{out}"
+
+        from photon_ml_tpu.io.index_map import load_partitioned
+        from photon_ml_tpu.io.model_io import load_game_model
+
+        imaps = {"global": load_partitioned(index_dir, "global")}
+        model = load_game_model(
+            os.path.join(out_dir, "models", "best"), imaps,
+            task="logistic_regression",
+        )
+        models[mode] = np.asarray(model.models["global"].coefficients.means)
+
+    # f32 solves with different reduction orders: agree at the optimum to
+    # f32-accumulation scale
+    scale = max(np.max(np.abs(models["off"])), 1.0)
+    assert np.max(np.abs(models["interpret"] - models["off"])) <= 5e-3 * scale
