@@ -461,3 +461,4 @@ def test_full_variance_on_tiled_works_and_ceiling_fails_early(avro_paths, tmp_pa
     )
     with pytest.raises(ValueError, match="variance=FULL"):
         prob.run(tb)
+

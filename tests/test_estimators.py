@@ -198,3 +198,81 @@ def test_validation_frequency_sweep(game_data):
 
     with pytest.raises(ValueError, match="validation_frequency"):
         CoordinateDescent({"x": object()}, validation_frequency="HOURLY")
+
+
+def test_estimator_fused_pallas_interpret_matches_off(tmp_path, monkeypatch):
+    """Estimator-level fused-path coverage (GameEstimator.fit driving the
+    fused kernels incl. SIMPLE variances through fused_hessian_stats): a fit
+    at fused-eligible shapes (4224 rows, 127 raw features + intercept = d
+    128, f32) with PHOTON_PALLAS=interpret must match the same fit with
+    fusion off. The gating assertion guards against this passing vacuously
+    on the jnp path. (The CLI driver itself runs f64 under the test config,
+    which is fusion-ineligible — CLI-level fused coverage lives in
+    tests/test_multihost.py.)"""
+    import jax.numpy as jnp
+
+    from photon_ml_tpu.estimators.game_estimator import CoordinateConfig, GameEstimator
+    from photon_ml_tpu.game.problem import GLMOptimizationConfig, _fusion_mode
+    from photon_ml_tpu.io import FeatureShardConfig, read_avro_dataset, write_avro_file
+    from photon_ml_tpu.io.schemas import TRAINING_EXAMPLE_AVRO
+    from photon_ml_tpu.optimize import OptimizerConfig
+    from photon_ml_tpu.ops.regularization import RegularizationContext
+
+    rng = np.random.default_rng(3)
+    n, d = 4224, 127
+    x = rng.normal(size=(n, d)) * 0.4
+    w = rng.normal(size=d) * 0.4
+    y = (rng.uniform(size=n) < 1 / (1 + np.exp(-(x @ w)))).astype(int)
+    recs = [
+        {
+            "label": float(y[i]),
+            "features": [
+                {"name": f"f{j}", "term": "", "value": float(x[i, j])}
+                for j in range(d)
+            ],
+        }
+        for i in range(n)
+    ]
+    data = str(tmp_path / "wide.avro")
+    write_avro_file(data, TRAINING_EXAMPLE_AVRO, recs)
+
+    raw, _ = read_avro_dataset(data, {"g": FeatureShardConfig(("features",))})
+    cfg = GLMOptimizationConfig(
+        optimizer=OptimizerConfig(tolerance=1e-9, max_iterations=80),
+        regularization=RegularizationContext("L2"),
+        reg_weight=1.0,
+        variance_type="SIMPLE",
+    )
+
+    # NOT vacuous: the estimator-built batch must be admitted by the gating
+    monkeypatch.setenv("PHOTON_PALLAS", "interpret")
+    probe = GameEstimator(
+        task="logistic_regression",
+        coordinate_configs=[CoordinateConfig(name="global", feature_shard="g", config=cfg)],
+        dtype=jnp.float32,
+    )
+    batch = probe._prepare_datasets(raw)["global"].batch
+    assert _fusion_mode(batch)[0] == "interpret"
+
+    results = {}
+    for mode in ("off", "interpret"):
+        monkeypatch.setenv("PHOTON_PALLAS", mode)
+        est = GameEstimator(
+            task="logistic_regression",
+            coordinate_configs=[
+                CoordinateConfig(name="global", feature_shard="g", config=cfg)
+            ],
+            dtype=jnp.float32,
+        )
+        res = est.fit(raw)[0]
+        m = res.model["global"]
+        results[mode] = (
+            np.asarray(m.model.coefficients.means),
+            np.asarray(m.model.coefficients.variances),
+        )
+    w_off, v_off = results["off"]
+    w_int, v_int = results["interpret"]
+    scale = max(np.max(np.abs(w_off)), 1.0)
+    assert np.max(np.abs(w_int - w_off)) <= 5e-3 * scale
+    vscale = max(np.max(np.abs(v_off)), 1e-12)
+    assert np.max(np.abs(v_int - v_off)) <= 1e-3 * vscale
