@@ -164,10 +164,15 @@ def bench_tpu(fe_ds, re_ds, reg=1.0, sweeps=1):
         return result
 
     run()  # warmup/compile
-    t0 = time.perf_counter()
-    result = run()
-    wall = time.perf_counter() - t0
-    return wall, result
+    # median of 3 timed sweeps: the harness TPU shows load-dependent jitter
+    # (consecutive same-window runs vary ~10%); a single sample would hand
+    # that straight to the recorded number
+    walls = []
+    for _ in range(3):
+        t0 = time.perf_counter()
+        result = run()
+        walls.append(time.perf_counter() - t0)
+    return sorted(walls)[1], result
 
 
 def bench_cpu_baseline(gx, y, ex, ids, reg=1.0, entity_subsample=10):
