@@ -277,3 +277,34 @@ def test_end_to_end_solve_matches_unfused(rng, monkeypatch, optimizer):
         rtol=1e-3,
         atol=1e-6,
     )
+
+
+def test_tile_rows_and_eligibility_constants():
+    """Guards the VMEM-derived tiling rules: budgets per dtype, the parts
+    divisor for multi-temporary kernels, the [128, 2048] clamp, and the
+    lane-dim multiple-of-128 invariant (Mosaic requirement on the [1, tn]
+    blocks — see the measured OOM notes in ops/pallas_glm.py)."""
+    # f32 budget 2MB: d=1024 -> 512 rows; bf16 budget 4MB: d=1024 -> 2048
+    assert pallas_glm.tile_rows(1024, 4) == 512
+    assert pallas_glm.tile_rows(1024, 2) == 2048
+    # parts=2 halves the budget (the Hessian-stats kernel's x*x temporary)
+    assert pallas_glm.tile_rows(1024, 4, parts=2) == 256
+    # clamps: tiny d caps at 2048 rows; the max fused dims keep >= 128 rows
+    assert pallas_glm.tile_rows(128, 4) == 2048
+    assert pallas_glm.tile_rows(pallas_glm.MAX_FUSED_DIM_F32, 4) == 128
+    assert pallas_glm.tile_rows(pallas_glm.MAX_FUSED_DIM_BF16, 2) == 256
+    for d in (128, 384, 1024, 4096, 8192):
+        for itemsize in (2, 4):
+            for parts in (1, 2):
+                tn = pallas_glm.tile_rows(d, itemsize, parts)
+                assert tn % 128 == 0 and 128 <= tn <= 2048
+
+    import jax.numpy as jnp
+
+    n = pallas_glm.MIN_FUSED_ROWS
+    # dtype-specific dim ceilings
+    assert pallas_glm.eligible(n, pallas_glm.MAX_FUSED_DIM_F32, jnp.float32)
+    assert not pallas_glm.eligible(n, pallas_glm.MAX_FUSED_DIM_F32 + 128, jnp.float32)
+    assert pallas_glm.eligible(n, pallas_glm.MAX_FUSED_DIM_BF16, jnp.bfloat16)
+    assert not pallas_glm.eligible(n, pallas_glm.MAX_FUSED_DIM_BF16 + 128, jnp.bfloat16)
+    assert not pallas_glm.eligible(n, 1024, jnp.float64)
