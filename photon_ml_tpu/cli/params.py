@@ -98,6 +98,9 @@ def parse_coordinate(spec: str) -> CoordinateConfig:
         ),
         layout=layout,
         feature_dtype=feature_dtype,
+        hbm_budget_mb=(
+            int(kv.pop("hbm.budget.mb")) if "hbm.budget.mb" in kv else None
+        ),
     )
     kv.pop("active.cap", None)
     if kv:

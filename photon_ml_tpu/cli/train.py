@@ -236,15 +236,35 @@ def run(argv: Optional[List[str]] = None) -> Dict:
     logger.info("training rows: %d; shard dims: %s", raw.n_rows, raw.shard_dims)
 
     validation = None
+    validation_pool = None
     if args.validation_data:
-        validation, _ = read_avro_dataset(
-            args.validation_data,
-            shards,
-            index_maps=index_maps,
-            id_tag_columns=id_tags,
-            response_column=args.response_column,
-            columns=input_columns,
-        )
+        def _read_validation():
+            v, _ = read_avro_dataset(
+                args.validation_data,
+                shards,
+                index_maps=index_maps,
+                id_tag_columns=id_tags,
+                response_column=args.response_column,
+                columns=input_columns,
+            )
+            return v
+
+        if multihost.process_count() == 1:
+            # ingest overlap: decode validation on a background thread (the
+            # native Avro decoder releases the GIL) while the training
+            # datasets build and upload; the estimator resolves the future
+            # only when the validation context is first needed
+            # (executor-parallel decode, AvroDataReader.scala:165-209)
+            import concurrent.futures
+
+            validation_pool = concurrent.futures.ThreadPoolExecutor(
+                1, thread_name_prefix="photon-val-decode"
+            )
+            validation = validation_pool.submit(_read_validation)
+        else:
+            # multi-process: keep the read on the main thread (collective
+            # ordering across hosts must stay deterministic)
+            validation = _read_validation()
 
     # normalization from feature statistics (GameTrainingDriver:555-571)
     if args.normalization != "NONE":
@@ -320,9 +340,11 @@ def run(argv: Optional[List[str]] = None) -> Dict:
     tuned_results: List[GameResult] = []
     if args.hyper_parameter_tuning != "NONE" and validation is not None:
         tuned_results = _run_tuning(
-            args, estimator, raw, validation, coords, results,
-            ckpt=ckpt, datasets_fn=get_datasets,
+            args, estimator, raw, _resolve_validation(validation), coords,
+            results, ckpt=ckpt, datasets_fn=get_datasets,
         )
+    if validation_pool is not None:
+        validation_pool.shutdown(wait=False)
 
     all_results = list(results) + tuned_results
     best = estimator.select_best(all_results)
@@ -361,6 +383,12 @@ def run(argv: Optional[List[str]] = None) -> Dict:
         )
     logger.info("saved %d model(s) to %s", len(to_save), args.output_dir)
     return summary
+
+
+def _resolve_validation(validation):
+    """Unwrap a deferred validation dataset (Future from the background
+    decode thread); already-resolved datasets pass through."""
+    return validation.result() if hasattr(validation, "result") else validation
 
 
 def _run_tuning(args, estimator, raw, validation, coords, prior_results,
@@ -620,6 +648,10 @@ class _Checkpoint:
 
     def fit_grid(self, estimator, raw, validation, datasets_fn, initial_model):
         import shutil
+
+        # checkpointed grids read validation directly (recovered-metric
+        # scoring): resolve any deferred decode up front
+        validation = _resolve_validation(validation)
 
         combos = self.state["grid"]
         n_iter = self.args.coordinate_descent_iterations

@@ -72,6 +72,11 @@ class CoordinateConfig:
     # incremental training: L2-regularize toward the warm-start model
     # ("Regularize by Previous Model During Warm-Start Training")
     regularize_by_prior: bool = False
+    # out-of-core random effects: when the entity blocks would exceed this
+    # device-memory budget, keep them host-resident and stream double-buffered
+    # entity slices through the chip (game/streaming.py; the reference's
+    # DISK_ONLY spill scale path). RE coordinates only; single-process.
+    hbm_budget_mb: Optional[int] = None
 
     @property
     def is_random_effect(self) -> bool:
@@ -129,18 +134,26 @@ class GameEstimator(EventEmitter):
         if unknown:
             raise ValueError(f"locked coordinates not in configs: {sorted(unknown)}")
         for cc in self.coordinate_configs:
-            if cc.feature_dtype is not None and (
-                cc.is_random_effect or cc.layout != "dense"
-            ):
-                # 'auto' is rejected too: it can resolve to ELL at fit time
-                # (d > 4096), which would fail deep inside data loading
-                # without the coordinate name — require an explicit dense
+            if cc.feature_dtype is not None and cc.layout == "tiled":
+                # dense/ell/coo fixed effects and RE entity blocks all accept
+                # narrow feature storage (solver state stays wide); the tiled
+                # shard_map path keeps its value arrays in the solve dtype
                 raise ValueError(
-                    f"coordinate {cc.name}: feature_dtype requires "
-                    "layout=dense on a fixed-effect coordinate "
-                    f"(got layout={cc.layout!r}"
-                    + (", random effect" if cc.is_random_effect else "")
-                    + ")"
+                    f"coordinate {cc.name}: feature_dtype is not supported "
+                    "with layout='tiled'"
+                )
+            if cc.hbm_budget_mb is not None and not cc.is_random_effect:
+                raise ValueError(
+                    f"coordinate {cc.name}: hbm_budget_mb applies to random-"
+                    "effect coordinates (fixed effects use layout='tiled' or "
+                    "'coo' for huge d)"
+                )
+            if cc.hbm_budget_mb is not None and mesh is not None:
+                raise ValueError(
+                    f"coordinate {cc.name}: streamed (hbm_budget_mb) and "
+                    "mesh-sharded random effects are not composable yet — "
+                    "streaming scales one chip's HBM, the mesh shards "
+                    "entities across chips"
                 )
             if cc.layout == "tiled":
                 if mesh is None:
@@ -148,11 +161,10 @@ class GameEstimator(EventEmitter):
                         f"coordinate {cc.name}: layout='tiled' requires the "
                         "estimator to be built with a device mesh"
                     )
-                if cc.normalization is not None:
-                    raise ValueError(
-                        f"coordinate {cc.name}: normalization is not supported "
-                        "with the tiled layout (stats live in the unpadded space)"
-                    )
+                # normalization works on tiled: GLMProblem pads the stats
+                # vectors to the mesh-padded dim with identity entries (the
+                # reference algebra is layout-agnostic,
+                # ValueAndGradientAggregator.scala:36-80)
                 # variance=FULL is supported on tiled via the chunked sharded
                 # X^T diag(c) X path (parallel/sparse.py xtcx) up to
                 # ops.glm.MAX_FULL_VARIANCE_DIM; the dim ceiling is checked at
@@ -190,6 +202,7 @@ class GameEstimator(EventEmitter):
                             dtype=self.dtype,
                             pad_entities_to_multiple=self.entity_pad_multiple,
                             features_to_samples_ratio=cc.features_to_samples_ratio,
+                            feature_dtype=cc.feature_dtype,
                         )
                         datasets[cc.name] = ds
                         continue
@@ -203,6 +216,12 @@ class GameEstimator(EventEmitter):
                         dtype=self.dtype,
                         pad_entities_to_multiple=self.entity_pad_multiple,
                         features_to_samples_ratio=cc.features_to_samples_ratio,
+                        feature_dtype=cc.feature_dtype,
+                        hbm_budget_bytes=(
+                            cc.hbm_budget_mb * (1 << 20)
+                            if cc.hbm_budget_mb is not None
+                            else None
+                        ),
                     )
                     if self.mesh is not None:
                         from ..parallel.mesh import shard_entity_blocks
@@ -333,9 +352,21 @@ class GameEstimator(EventEmitter):
         instead of the full cartesian grid (checkpoint resume trains the
         remaining combos one at a time). ``n_cd_iterations`` overrides the
         estimator's sweep count for THIS call (resuming a partly-trained
-        configuration)."""
+        configuration).
+
+        ``validation`` may be a RawDataset, or a deferred one — a
+        ``concurrent.futures.Future`` or zero-arg callable resolving to a
+        RawDataset. A deferred validation is resolved only AFTER the training
+        datasets are built, so a background decode thread (the CLI's ingest
+        overlap; the native Avro decoder releases the GIL) runs concurrently
+        with dataset preparation and device uploads."""
         if datasets is None:
             datasets = self._prepare_datasets(raw)
+        if validation is not None:
+            if hasattr(validation, "result"):
+                validation = validation.result()
+            elif callable(validation):
+                validation = validation()
         validation_ctx = None
         if validation is not None:
             # evaluator_specs default to RMSE inside _validation_context

@@ -14,6 +14,7 @@ descent loop owns residual composition (CoordinateDataScores semantics, P7).
 from __future__ import annotations
 
 import dataclasses
+import os
 import weakref
 from functools import partial
 from typing import Optional, Tuple, Union
@@ -187,9 +188,13 @@ class RandomEffectCoordinate(Coordinate):
         residual_scores: Optional[Array],
         initial_model: Optional[RandomEffectModel] = None,
     ) -> Tuple[RandomEffectModel, SolverResult]:
+        if self.dataset.streamed:
+            return self._train_streamed(residual_scores, initial_model)
         blocks = self.dataset.blocks
         E, K, S = blocks.features.shape
-        dtype = blocks.features.dtype
+        # solver state stays in the WIDE dtype: features may be stored bf16
+        # (feature_dtype), labels/weights/offsets carry the solve precision
+        dtype = blocks.labels.dtype
 
         if residual_scores is not None:
             res_blocks = jnp.take(
@@ -249,9 +254,10 @@ class RandomEffectCoordinate(Coordinate):
             max_cg_iterations=solver_cfg.max_cg_iterations,
             max_improvement_failures=solver_cfg.max_improvement_failures,
         )
+        train_fn = _train_blocks if _re_solver_mode() == "vmapped" else _train_blocks_packed
         segments = _size_buckets(self.dataset, align=_entity_shard_align(blocks))
         if segments is None:
-            results = _train_blocks(
+            results = train_fn(
                 blocks.features, blocks.labels, offsets, blocks.weights,
                 w0, prior_mean, prior_prec, **solver_kwargs,
             )
@@ -264,7 +270,7 @@ class RandomEffectCoordinate(Coordinate):
             parts = []
             for start, end, kb, sb in segments:
                 parts.append(
-                    _train_blocks(
+                    train_fn(
                         blocks.features[start:end, :kb, :sb],
                         blocks.labels[start:end, :kb],
                         offsets[start:end, :kb],
@@ -304,6 +310,82 @@ class RandomEffectCoordinate(Coordinate):
         object.__setattr__(model, "_support_layout_of", weakref.ref(self.dataset))
         return model, results
 
+    def _train_streamed(
+        self,
+        residual_scores: Optional[Array],
+        initial_model: Optional[RandomEffectModel] = None,
+    ) -> Tuple[RandomEffectModel, SolverResult]:
+        """Out-of-core solve: host-resident blocks streamed through the chip
+        in double-buffered entity slices (game/streaming.py; the reference's
+        DISK_ONLY spill scale path, CoordinateDescent.scala:262,404)."""
+        from .streaming import solve_streamed
+
+        ds = self.dataset
+        blocks = ds.blocks  # host numpy
+        E, K, S = blocks.features.shape
+        sdt = blocks.labels.dtype  # solve dtype (features may be narrower)
+
+        if initial_model is not None:
+            w0 = _project_model_values(
+                ds, initial_model, initial_model.coef_values, sdt, to_device=False
+            )
+        else:
+            w0 = np.zeros((E, S), sdt)
+        prior_mean = np.zeros((E, S), sdt)
+        prior_prec = np.ones((E, S), sdt)
+        if self.prior_model is not None:
+            prior_mean = _project_model_values(
+                ds, self.prior_model, self.prior_model.coef_values, sdt,
+                to_device=False,
+            )
+            if self.prior_model.variances is not None:
+                var = _project_model_values(
+                    ds, self.prior_model, self.prior_model.variances, sdt,
+                    to_device=False,
+                )
+                prior_prec = (1.0 / np.maximum(var, 1e-12)).astype(sdt)
+
+        cfg = self.config
+        solver_cfg = cfg.solver_config()
+        solver_kwargs = dict(
+            task=self.task,
+            l2=cfg.regularization.l2_weight(cfg.reg_weight),
+            l1=solver_cfg.l1_weight,
+            optimizer_type=OptimizerType(solver_cfg.normalized_type()).value,
+            tolerance=solver_cfg.tolerance,
+            max_iterations=solver_cfg.max_iterations,
+            num_corrections=solver_cfg.num_corrections,
+            max_cg_iterations=solver_cfg.max_cg_iterations,
+            max_improvement_failures=solver_cfg.max_improvement_failures,
+        )
+        segments = _size_buckets(ds) or [(0, E, K, S)]
+        train_fn = (
+            _train_blocks if _re_solver_mode() == "vmapped" else _train_blocks_packed
+        )
+        results = solve_streamed(
+            blocks,
+            segments,
+            residual_scores,
+            w0,
+            prior_mean,
+            prior_prec,
+            ds.hbm_budget_bytes,
+            train_fn,
+            solver_kwargs,
+        )
+        coef_indices = blocks.proj_cols
+        valid = coef_indices >= 0
+        model = RandomEffectModel(
+            random_effect_type=ds.random_effect_type,
+            feature_shard=ds.feature_shard,
+            task=self.task,
+            entity_ids=ds.entity_ids,
+            coef_indices=coef_indices,
+            coef_values=np.where(valid, results.coefficients, 0.0),
+        )
+        object.__setattr__(model, "_support_layout_of", weakref.ref(ds))
+        return model, results
+
     def _support_layout_matches(self, model: RandomEffectModel) -> bool:
         """True when model.coef_indices is this dataset's own block layout
         (the coordinate-descent case). Checks provenance/identity first;
@@ -339,6 +421,35 @@ class RandomEffectCoordinate(Coordinate):
         return ok
 
     def score(self, model: RandomEffectModel) -> Array:
+        if self.dataset.streamed:
+            from .streaming import score_streamed
+
+            ds = self.dataset
+            same_layout = list(map(str, ds.entity_ids)) == list(
+                map(str, model.entity_ids)
+            ) and self._support_layout_matches(model)
+            sdt = np.dtype(ds.blocks.labels.dtype)  # solve/residual dtype
+            if same_layout:
+                vals = np.asarray(model.coef_values, sdt)
+            else:
+                # re-project a differently laid-out model into this dataset's
+                # entity/subspace layout on host (no device round trip)
+                vals = _project_model_values(
+                    ds, model, model.coef_values, sdt, to_device=False
+                )
+            cache = getattr(ds, "_stream_xsub_cache", None)
+            scores, cache = score_streamed(
+                vals,
+                np.asarray(ds.blocks.proj_cols),
+                ds.row_entity,
+                ds.ell_idx,
+                ds.ell_val,
+                ds.hbm_budget_bytes,
+                cache,
+                score_dtype=jnp.promote_types(ds.ell_val.dtype, sdt),
+            )
+            object.__setattr__(ds, "_stream_xsub_cache", cache)
+            return scores
         row_entity = self.dataset.row_entity
         # The model's entity-row order may differ from this dataset's block
         # order (warm start from a loaded model, locked partial-retrain
@@ -349,22 +460,26 @@ class RandomEffectCoordinate(Coordinate):
         m_ids = list(map(str, model.entity_ids))
         if ds_ids == m_ids and self._support_layout_matches(model):
             # coordinate-descent hot path: the support LAYOUT is this
-            # dataset's own block layout, so the searchsorted feature->support
-            # mapping is computed once and cached; each sweep's score is then
-            # a single flat gather (models/game.py score_entity_ell_at)
-            from ..models.game import ell_support_positions, score_entity_ell_at
+            # dataset's own block layout, so the row features are densified
+            # into entity-subspace layout once and cached; each sweep's score
+            # is then one contiguous row gather + elementwise dot
+            # (models/game.py score_entity_rows_dense)
+            from ..models.game import ell_row_subspace, score_entity_rows_dense
 
-            cache = getattr(self.dataset, "_score_pos_cache", None)
+            cache = getattr(self.dataset, "_score_xsub_cache", None)
             if cache is None:
-                cache = ell_support_positions(
-                    model.coef_indices, row_entity, self.dataset.ell_idx
+                cache = ell_row_subspace(
+                    model.coef_indices, row_entity,
+                    self.dataset.ell_idx, self.dataset.ell_val,
                 )
-                object.__setattr__(self.dataset, "_score_pos_cache", cache)
-            pos, hit = cache
-            vals = jnp.asarray(model.coef_values, self.dataset.ell_val.dtype)
-            return score_entity_ell_at(
-                vals, row_entity, pos, hit, self.dataset.ell_val
+                object.__setattr__(self.dataset, "_score_xsub_cache", cache)
+            # scores compute in the WIDE dtype: bf16 feature storage must not
+            # truncate the coefficients or the residual stream
+            score_dt = jnp.promote_types(
+                self.dataset.ell_val.dtype, self.dataset.blocks.labels.dtype
             )
+            vals = jnp.asarray(model.coef_values, score_dt)
+            return score_entity_rows_dense(vals, row_entity, cache)
         if ds_ids != m_ids:
             block_to_model = model.rows_for(self.dataset.entity_ids).astype(np.int32)
             row_entity = jnp.where(
@@ -372,12 +487,27 @@ class RandomEffectCoordinate(Coordinate):
                 jnp.take(jnp.asarray(block_to_model), jnp.maximum(row_entity, 0)),
                 -1,
             ).astype(jnp.int32)
-        ds_dtype = self.dataset.ell_val.dtype
+        ds_dtype = jnp.promote_types(
+            self.dataset.ell_val.dtype, self.dataset.blocks.labels.dtype
+        )
         if model.coef_values.dtype != ds_dtype:
             model = dataclasses.replace(
                 model, coef_values=jnp.asarray(model.coef_values, ds_dtype)
             )
         return model.score_ell_rows(row_entity, self.dataset.ell_idx, self.dataset.ell_val)
+
+
+def _re_solver_mode() -> str:
+    """Random-effect solver selection: 'packed' (default, entity-minor
+    lane-packed lockstep solves) or 'vmapped' (the entity-leading vmapped
+    path, bit-exact across bucket shapes — the parity/debug escape hatch).
+    Unknown values raise instead of silently picking a default."""
+    mode = os.environ.get("PHOTON_RE_SOLVER", "packed").strip().lower()
+    if mode not in ("packed", "vmapped"):
+        raise ValueError(
+            f"PHOTON_RE_SOLVER={mode!r}: expected 'packed' or 'vmapped'"
+        )
+    return mode
 
 
 def _pow2_ceil(x: np.ndarray) -> np.ndarray:
@@ -471,11 +601,14 @@ def _concat_results(parts, S: int) -> SolverResult:
 
 
 def _project_model_values(
-    dataset: RandomEffectDataset, model: RandomEffectModel, values, dtype
+    dataset: RandomEffectDataset, model: RandomEffectModel, values, dtype,
+    to_device: bool = True,
 ) -> Array:
     """Project per-entity values stored in ``model``'s (entity, support)
     layout into this dataset's entity/subspace block layout (model projection,
-    reference ModelProjection.scala:30-85)."""
+    reference ModelProjection.scala:30-85). ``to_device=False`` keeps the
+    result in host numpy (streamed datasets must not materialize [E, S] on
+    device)."""
     blocks = dataset.blocks
     E, S = blocks.proj_cols.shape
     # multi-process: blocks.proj_cols is entity-sharded (not host-addressable);
@@ -489,7 +622,10 @@ def _project_model_values(
         and np.array_equal(np.asarray(model.coef_indices), pc_host)
         and list(map(str, model.entity_ids)) == list(map(str, dataset.entity_ids))
     ):
-        return jnp.asarray(values, dtype)  # same layout: reuse directly
+        # same layout: reuse directly
+        if not to_device:
+            return np.asarray(values, dtype)
+        return jnp.asarray(values, dtype)
     # general path: one vectorized sorted-key lookup over all (entity, column)
     # support pairs — no per-entity Python loop and no dense [E, global_dim]
     # intermediate, so re-projecting a large RE model from a differently
@@ -520,7 +656,7 @@ def _project_model_values(
         pos = np.clip(np.searchsorted(mkeys_s, dkeys, side="right") - 1, 0, None)
         hit = mkeys_s[pos] == dkeys
         w0[de[hit], dsl[hit]] = mvals_s[pos[hit]]
-    return jnp.asarray(w0, dtype)
+    return np.asarray(w0, dtype) if not to_device else jnp.asarray(w0, dtype)
 
 
 def _initial_subspace_coefficients(
@@ -601,6 +737,113 @@ def _train_blocks(
 
     return jax.vmap(solve_one)(
         features, labels, offsets, weights, w0, prior_mean, prior_prec
+    )
+
+
+@partial(
+    jax.jit,
+    static_argnames=(
+        "task",
+        "l2",
+        "l1",
+        "optimizer_type",
+        "tolerance",
+        "max_iterations",
+        "num_corrections",
+        "max_cg_iterations",
+        "max_improvement_failures",
+    ),
+)
+def _train_blocks_packed(
+    features: Array,  # [E, K, S]
+    labels: Array,
+    offsets: Array,
+    weights: Array,
+    w0: Array,  # [E, S]
+    prior_mean: Array,  # [E, S]; zeros = plain L2
+    prior_prec: Array,  # [E, S]; ones = plain L2
+    *,
+    task: str,
+    l2: float,
+    l1: float,
+    optimizer_type: str,
+    tolerance: float,
+    max_iterations: int,
+    num_corrections: int,
+    max_cg_iterations: int,
+    max_improvement_failures: int,
+) -> SolverResult:
+    """Entity-minor lockstep solve over all entity blocks.
+
+    Same contract as :func:`_train_blocks`, but instead of vmapping with the
+    entity axis leading ([E, K, S] puts S in the TPU's 128-wide lane dimension
+    — at S=32 that wastes 3/4 of every vector op), the data is transposed so
+    the ENTITY axis is minor: features [K, S, E], coefficients [S, E]. Every
+    solver op is then elementwise over a fully packed lane dimension whatever
+    S is, and the per-entity reductions are axis-0 sums. This is the
+    lane-packing redesign of the reference's per-partition sequential solves
+    (RandomEffectCoordinate.scala:273-329). The transpose happens inside jit
+    so GSPMD sharding propagates (entity-sharded blocks stay entity-sharded
+    on the trailing axis).
+    """
+    loss = get_loss(task)
+    # features may be stored narrower (bf16); products below promote to the
+    # labels' (solve) dtype on the fly, halving the F sweep traffic
+    F = jnp.transpose(features, (1, 2, 0))  # [K, S, E]
+    y = labels.T  # [K, E]
+    off = offsets.T.astype(labels.dtype)
+    wt = weights.T
+    w0t = w0.T  # [S, E]
+    pm = prior_mean.T
+    pp = prior_prec.T
+
+    def value_and_grad(w):  # [S, E] -> ([E], [S, E])
+        z = jnp.sum(F * w[None, :, :], axis=1) + off  # [K, E]
+        lvals, dz = loss.loss_and_dz(z, y)
+        wdz = wt * dz
+        value = jnp.sum(wt * lvals, axis=0)  # [E]
+        grad = jnp.sum(F * wdz[:, None, :], axis=0)  # [S, E]
+        delta = w - pm
+        value = value + 0.5 * l2 * jnp.sum(pp * delta * delta, axis=0)
+        grad = grad + l2 * pp * delta
+        return value, grad
+
+    def hessian_vector(w, v):
+        z = jnp.sum(F * w[None, :, :], axis=1) + off
+        c = wt * loss.d2z(z, y) * jnp.sum(F * v[None, :, :], axis=1)  # [K, E]
+        return jnp.sum(F * c[:, None, :], axis=0) + l2 * pp * v
+
+    loss_tol, grad_tol = abs_tolerances(value_and_grad, w0t, tolerance)
+    if optimizer_type == "TRON":
+        res = solve_tron(
+            value_and_grad,
+            hessian_vector,
+            w0t,
+            loss_tol,
+            grad_tol,
+            max_iterations=max_iterations,
+            max_cg_iterations=max_cg_iterations,
+            max_improvement_failures=max_improvement_failures,
+        )
+    else:
+        res = solve_lbfgs(
+            value_and_grad,
+            w0t,
+            loss_tol,
+            grad_tol,
+            max_iterations=max_iterations,
+            num_corrections=num_corrections,
+            l1_weight=l1,
+            batched=True,
+        )
+    return SolverResult(
+        coefficients=res.coefficients.T,
+        loss=res.loss,
+        gradient=res.gradient.T,
+        iterations=res.iterations,
+        reason=res.reason,
+        loss_history=res.loss_history.T,
+        grad_norm_history=res.grad_norm_history.T,
     )
 
 

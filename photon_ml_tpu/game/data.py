@@ -119,6 +119,12 @@ class RandomEffectDataset:
     # entity-sharded across processes, so not host-addressable); model
     # projection / warm-start layout checks read this instead
     host_proj_cols: Optional[np.ndarray] = None
+    # out-of-core mode (game/streaming.py): blocks hold HOST numpy arrays and
+    # training/scoring stream entity slices through the chip under this HBM
+    # budget — the product path for models bigger than device memory
+    # (reference: DISK_ONLY spill, CoordinateDescent.scala:262,404)
+    streamed: bool = False
+    hbm_budget_bytes: Optional[int] = None
 
     @property
     def num_entities(self) -> int:
@@ -276,8 +282,15 @@ def _pearson_keep_mask(
     k_keep = np.ceil(ratio * n_e).astype(np.int64)
     k_keep = np.where(k_keep < n_active, k_keep, n_active)
 
-    # rank columns by descending |score| (stable: earlier column wins ties)
-    absc = np.where(proj_cols >= 0, np.abs(score), -1.0)
+    # rank columns by descending |score| (stable: earlier column wins ties).
+    # |score| is quantized to a 1e-12 grid first: host-numpy and XLA f64
+    # reductions can disagree in the last ulps (~1e-13), which would turn an
+    # exact host tie into a device near-tie and flip which tied column is
+    # kept — the grid collapses both onto the same key so the column-order
+    # tie-break decides identically on both paths (determinism-for-recovery,
+    # SURVEY §5 A2). Residual window: a score ~1 ulp from a grid midpoint can
+    # still round apart — vanishing, not provably zero.
+    absc = np.where(proj_cols >= 0, np.round(np.abs(score), 12), -1.0)
     order = np.argsort(-absc, axis=1, kind="stable")
     rank = np.empty((E, S), dtype=np.int64)
     np.put_along_axis(rank, order, np.broadcast_to(np.arange(S), (E, S)), axis=1)
@@ -295,6 +308,8 @@ def build_random_effect_dataset(
     dtype=jnp.float32,
     pad_entities_to_multiple: int = 1,
     features_to_samples_ratio: Optional[float] = None,
+    feature_dtype=None,
+    hbm_budget_bytes: Optional[int] = None,
 ) -> RandomEffectDataset:
     """Host-side dataset build (the one-time "shuffle" of SURVEY.md §2.1 P13).
 
@@ -304,6 +319,16 @@ def build_random_effect_dataset(
     features_to_samples_ratio: numFeaturesToSamplesRatioUpperBound — per
     entity, keep only the ceil(ratio * n_rows) features with the largest
     |Pearson(feature, label)| (RandomEffectDataset.scala:553-565).
+    feature_dtype: optional narrower storage type (e.g. bfloat16) for the
+    entity-block FEATURES and the ELL scoring values only — labels, offsets,
+    weights and all solver state stay ``dtype``; objective products promote
+    on the fly (halves the HBM traffic of the RE solve, which dominates the
+    GLMix sweep).
+    hbm_budget_bytes: when set and the entity blocks would exceed this many
+    device bytes, the dataset is built STREAMED: blocks stay in host numpy
+    and training/scoring pipeline double-buffered entity slices through the
+    chip (game/streaming.py) — the out-of-core path for models bigger than
+    HBM.
     """
     n = raw.n_rows
     ids = raw.id_tags[random_effect_type]
@@ -412,14 +437,35 @@ def build_random_effect_dataset(
         proj_cols_np = proj_cols_np[:, :S]
         feats = feats[:, :, :S]
 
-    blocks = EntityBlocks(
-        features=jnp.asarray(feats, dtype),
-        labels=jnp.asarray(labels_b, dtype),
-        offsets=jnp.asarray(offsets_b, dtype),
-        weights=jnp.asarray(weights_b, dtype),
-        proj_cols=jnp.asarray(proj_cols_np),
-        active_rows=jnp.asarray(active_rows_np.astype(np.int32)),
-    )
+    fdt = np.dtype(jnp.zeros((), feature_dtype or dtype).dtype)
+    sdt = np.dtype(jnp.zeros((), dtype).dtype)
+    streamed = False
+    if hbm_budget_bytes is not None:
+        from .streaming import estimate_block_bytes
+
+        E_b, K_b, S_b = feats.shape
+        streamed = (
+            estimate_block_bytes(E_b, K_b, S_b, fdt.itemsize) > hbm_budget_bytes
+        )
+    if streamed:
+        # host-resident blocks: train/score stream slices (game/streaming.py)
+        blocks = EntityBlocks(
+            features=feats.astype(fdt),
+            labels=labels_b.astype(sdt),
+            offsets=offsets_b.astype(sdt),
+            weights=weights_b.astype(sdt),
+            proj_cols=proj_cols_np.astype(np.int32),
+            active_rows=active_rows_np.astype(np.int32),
+        )
+    else:
+        blocks = EntityBlocks(
+            features=jnp.asarray(feats, feature_dtype or dtype),
+            labels=jnp.asarray(labels_b, dtype),
+            offsets=jnp.asarray(offsets_b, dtype),
+            weights=jnp.asarray(weights_b, dtype),
+            proj_cols=jnp.asarray(proj_cols_np),
+            active_rows=jnp.asarray(active_rows_np.astype(np.int32)),
+        )
 
     row_entity = np.where(entity_of_row >= 0, entity_of_row, -1).astype(np.int32)
     kept_ids = uniq[kept_entities].astype(str)
@@ -435,8 +481,10 @@ def build_random_effect_dataset(
         blocks=blocks,
         row_entity=jnp.asarray(row_entity),
         ell_idx=jnp.asarray(ell_idx_np),
-        ell_val=jnp.asarray(ell_val_np, dtype),
+        ell_val=jnp.asarray(ell_val_np, feature_dtype or dtype),
         passive_rows=passive,
         entity_counts=np.sum(active_rows_np >= 0, axis=1).astype(np.int64),
         entity_subspace_dims=per_entity_s.astype(np.int64),
+        streamed=streamed,
+        hbm_budget_bytes=hbm_budget_bytes if streamed else None,
     )

@@ -31,12 +31,14 @@ read its own row range), so the build splits into
 
 Single-process, this degrades to plain device_puts and produces bit-identical
 planning to `build_random_effect_dataset` (same `_EntityPlan`, same reservoir
-order) — asserted by tests/test_re_build.py's parity tests. The one exception
-is Pearson feature selection: scores are computed in wide precision on device,
-but EXACT score ties (common for tiny entities, e.g. four columns all scoring
-sqrt(6)/4) are broken by floating summation order, which differs between host
-numpy and XLA reductions — selection counts always agree, the specific tied
-column kept may not.
+order) — asserted by tests/test_re_build.py's parity tests. Pearson feature
+selection included: scores are computed in wide precision and quantized to a
+1e-12 grid before ranking, so the ~1e-13 reduction-order differences between
+host numpy and XLA collapse onto the same sort key and the stable
+column-order tie-break keeps the SAME column on both paths (exact ties are
+common for tiny entities, e.g. four columns all scoring sqrt(6)/4). This is
+a mitigation with a vanishing — not zero — failure window: a true score
+within ~1 ulp of a grid midpoint can still round apart on the two paths.
 """
 
 from __future__ import annotations
@@ -75,6 +77,7 @@ def build_random_effect_dataset_global(
     dtype=jnp.float32,
     pad_entities_to_multiple: int = 1,
     features_to_samples_ratio: Optional[float] = None,
+    feature_dtype=None,
 ) -> RandomEffectDataset:
     """Build a RandomEffectDataset whose row axis spans ALL processes' rows.
 
@@ -258,11 +261,13 @@ def build_random_effect_dataset_global(
 
     host_pc = np.asarray(multihost.fully_replicate(pc, mesh))
 
-    # --- 6. assemble (downcast wide staging to the block dtype) --------------
-    if build_dtype != np_dtype:
-        feats = feats.astype(dtype)
+    # --- 6. assemble (downcast wide staging to the block dtype; features and
+    # ELL values optionally narrower via feature_dtype) -----------------------
+    fdt = feature_dtype or dtype
+    if build_dtype != np_dtype or feature_dtype is not None:
+        feats = feats.astype(fdt)
         lb = lb.astype(dtype)
-        elv_g = elv_g.astype(dtype)
+        elv_g = elv_g.astype(fdt)
     blocks = EntityBlocks(
         features=feats,
         labels=lb,
@@ -345,7 +350,10 @@ def _pearson_select_device(
         n_active = (pc >= 0).sum(axis=1)
         k_keep = jnp.ceil(ratio * n_e).astype(jnp.int64)
         k_keep = jnp.minimum(k_keep, n_active)
-        absc = jnp.where(pc >= 0, jnp.abs(score), -1.0)
+        # quantize to the same 1e-12 grid as the host path: ulp-level
+        # reduction-order differences collapse onto one key, so the stable
+        # column-order tie-break picks the SAME column on both paths
+        absc = jnp.where(pc >= 0, jnp.round(jnp.abs(score), 12), -1.0)
         order = jnp.argsort(-absc, axis=1, stable=True)
         rank = (
             jnp.zeros((E, S), jnp.int64)

@@ -116,12 +116,20 @@ class GLMProblem:
     # its precisions (README.md:102-103 "Regularize by Previous Model")
     prior: Optional[Coefficients] = None
 
+    def _norm_for(self, batch: LabeledBatch) -> Optional[NormalizationContext]:
+        """Normalization stats padded to the batch's (possibly mesh-padded)
+        feature dim — identity entries on structural padding dims."""
+        if self.normalization is None:
+            return None
+        return self.normalization.padded(batch.dim)
+
     def objective(
         self,
         batch: LabeledBatch,
         fused: Optional[str] = None,
         fused_mesh=None,
     ) -> GLMObjective:
+        norm = self._norm_for(batch)
         prior_mean = prior_precision = None
         if self.prior is not None:
             dtype = batch.labels.dtype
@@ -142,7 +150,7 @@ class GLMProblem:
             loss=get_loss(self.task),
             batch=batch,
             l2=self.config.regularization.l2_weight(self.config.reg_weight),
-            norm=self.normalization,
+            norm=norm,
             prior_mean=prior_mean,
             prior_precision=prior_precision,
             fused=fused,
@@ -161,19 +169,12 @@ class GLMProblem:
         mapped to the transformed space, optimization runs there, the final
         coefficients map back.
         """
-        if (
-            getattr(batch.features, "layout", None) == "tiled"
-            and self.config.variance_type.upper() == "FULL"
-        ):
-            # fail BEFORE the (possibly hours-long) solve, not after it
-            from ..ops.glm import MAX_FULL_VARIANCE_DIM
+        if self.config.variance_type.upper() == "FULL":
+            # fail BEFORE the (possibly hours-long) solve, not after it —
+            # same check (and exception) as the post-solve entry points
+            from ..ops.glm import check_full_variance_dim
 
-            if batch.dim > MAX_FULL_VARIANCE_DIM:
-                raise ValueError(
-                    f"variance=FULL on the tiled layout needs a [d, d] Hessian "
-                    f"inverse; d={batch.dim} exceeds the supported ceiling "
-                    f"{MAX_FULL_VARIANCE_DIM} — use variance=SIMPLE"
-                )
+            check_full_variance_dim(batch.dim)
         fused, fused_mesh = _fusion_mode(batch)
         obj = self.objective(batch, fused=fused, fused_mesh=fused_mesh)
         dtype = batch.labels.dtype
@@ -205,7 +206,9 @@ class GLMProblem:
 
         means = result.coefficients
         if self.normalization is not None:
-            means = self.normalization.model_to_original_space(means)
+            # padded to batch.dim: tiled coefficients live in the mesh-padded
+            # space until the coordinate trims them back to d_true
+            means = self._norm_for(batch).model_to_original_space(means)
             # variances stay in transformed space in the reference as well
 
         model = model_for_task(

@@ -1,0 +1,213 @@
+"""Out-of-core random-effect training: entity-block slices streamed through HBM.
+
+The reference reaches "hundreds of billions of coefficients"
+(/root/reference/README.md:56) because Spark spills: RandomEffectDataset RDDs
+persist DISK_ONLY and stream through executors
+(photon-lib .../algorithm/CoordinateDescent.scala:262,404;
+RandomEffectDataset.scala:51-66). The TPU re-design keeps entity blocks in
+HOST memory (numpy) and pipelines fixed-size entity slices through the chip:
+
+- the slice size is chosen from an explicit HBM budget (bytes), halved for
+  double buffering;
+- slice i+1's ``jax.device_put`` is dispatched BEFORE slice i's solve is
+  awaited, so the H2D transfer overlaps compute (measured in
+  ``bench.py --config billion``: at on-host PCIe the transfer hides entirely
+  under the solve);
+- per-slice results are fetched to host numpy as soon as the NEXT slice's
+  solve is dispatched, so device residency stays bounded by ~2 slices of
+  data + solver state regardless of total model size.
+
+Slices respect the size-bucket segmentation (``_size_buckets``), so each
+solve call keeps the bucket's (K, S)-rounded shapes and the packed solver's
+lane economy. Scoring streams the per-entity coefficient table through the
+chip the same way (the model itself is bigger than the budget by
+assumption).
+
+Single-process by design: multi-process GLMix shards entities ACROSS hosts
+(game/data_mp.py) — streaming is the scale-up story for one chip's HBM,
+sharding is the scale-out story. The two compose at the estimator level
+(each host streams its own entity shard) but that composition is not wired
+yet; ``GameEstimator`` refuses streamed + multiprocess.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..optimize import SolverResult
+
+Array = jax.Array
+
+
+def estimate_block_bytes(E: int, K: int, S: int, feature_itemsize: int = 4) -> int:
+    """Device bytes of an in-HBM EntityBlocks of this shape (features +
+    labels/offsets/weights + proj_cols/active_rows)."""
+    return E * K * S * feature_itemsize + 3 * E * K * 4 + E * S * 4 + E * K * 4
+
+
+def entities_per_slice(
+    budget_bytes: int, K: int, S: int, feature_itemsize: int = 4, multiple: int = 8
+) -> int:
+    """Entities per streamed slice under ``budget_bytes``: double-buffered
+    (2 slices resident) plus ~4 [E_s, S] f32 solver-state arrays per entity
+    lane (w0/prior/coef/grad; the L-BFGS history is bounded separately by the
+    solve itself)."""
+    per_entity = 2 * (K * S * feature_itemsize + 3 * K * 4 + S * 4 + K * 4) + 4 * S * 4
+    e = max(budget_bytes // max(per_entity, 1), multiple)
+    return int(e // multiple * multiple)
+
+
+def solve_streamed(
+    blocks_np,  # EntityBlocks holding HOST numpy arrays
+    segments,  # [(start, end, K_b, S_b)] from _size_buckets (or one segment)
+    residual_scores: Optional[Array],  # device f[n] or None
+    w0_np: np.ndarray,  # [E, S] host
+    prior_mean_np: np.ndarray,
+    prior_prec_np: np.ndarray,
+    budget_bytes: int,
+    train_fn,  # _train_blocks or _train_blocks_packed
+    solver_kwargs: dict,
+) -> SolverResult:
+    """Double-buffered streamed solve over all entity slices; returns a
+    host-materialized SolverResult in entity order (numpy arrays)."""
+    E, K, S = blocks_np.features.shape
+    feat_itemsize = blocks_np.features.dtype.itemsize
+
+    # build the flat slice list: buckets split into budget-sized windows
+    slices = []
+    for start, end, kb, sb in segments:
+        step = max(min(entities_per_slice(budget_bytes, kb, sb, feat_itemsize), end - start), 8)
+        for s0 in range(start, end, step):
+            s1 = min(s0 + step, end)
+            slices.append((s0, s1, kb, sb))
+
+    def stage(sl):
+        s0, s1, kb, sb = sl
+        host = (
+            blocks_np.features[s0:s1, :kb, :sb],
+            blocks_np.labels[s0:s1, :kb],
+            blocks_np.offsets[s0:s1, :kb],
+            blocks_np.weights[s0:s1, :kb],
+            blocks_np.active_rows[s0:s1, :kb],
+            w0_np[s0:s1, :sb],
+            prior_mean_np[s0:s1, :sb],
+            prior_prec_np[s0:s1, :sb],
+        )
+        return [jax.device_put(np.ascontiguousarray(a)) for a in host]
+
+    def dispatch(staged):
+        feats, labels, offsets, weights, active_rows, w0, pm, pp = staged
+        if residual_scores is not None:
+            res = jnp.take(
+                residual_scores, jnp.maximum(active_rows, 0), axis=0
+            ) * (active_rows >= 0)
+            offsets = offsets + res.astype(offsets.dtype)
+        return train_fn(feats, labels, offsets, weights, w0, pm, pp, **solver_kwargs)
+
+    # solve dtype follows the dataset's labels (features may be narrower):
+    # a f64-configured streamed dataset keeps f64 results, like the in-HBM path
+    sdt = np.dtype(blocks_np.labels.dtype)
+    out_coef = np.zeros((E, S), sdt)
+    out_grad = np.zeros((E, S), sdt)
+    out_loss = np.zeros(E, sdt)
+    out_it = np.zeros(E, np.int32)
+    out_reason = np.zeros(E, np.int32)
+    T = solver_kwargs["max_iterations"] + 1
+    out_lh = np.full((E, T), np.nan, sdt)
+    out_gh = np.full((E, T), np.nan, sdt)
+
+    def collect(sl, res):
+        s0, s1, _, sb = sl
+        out_coef[s0:s1, :sb] = np.asarray(res.coefficients, sdt)
+        out_grad[s0:s1, :sb] = np.asarray(res.gradient, sdt)
+        out_loss[s0:s1] = np.asarray(res.loss, sdt)
+        out_it[s0:s1] = np.asarray(res.iterations)
+        out_reason[s0:s1] = np.asarray(res.reason)
+        out_lh[s0:s1] = np.asarray(res.loss_history, sdt)
+        out_gh[s0:s1] = np.asarray(res.grad_norm_history, sdt)
+
+    staged = stage(slices[0])
+    pending = None  # (slice, dispatched result)
+    for i, sl in enumerate(slices):
+        res = dispatch(staged)  # async dispatch on the staged slice
+        if i + 1 < len(slices):
+            staged = stage(slices[i + 1])  # H2D overlaps the running solve
+        if pending is not None:
+            collect(*pending)  # fetch of slice i-1 syncs AFTER i is queued
+        pending = (sl, res)
+    collect(*pending)
+
+    return SolverResult(
+        coefficients=out_coef,
+        loss=out_loss,
+        gradient=out_grad,
+        iterations=out_it,
+        reason=out_reason,
+        loss_history=out_lh,
+        grad_norm_history=out_gh,
+    )
+
+
+def score_streamed(
+    coef_values_np: np.ndarray,  # [E, S] host model table
+    proj_cols_np: np.ndarray,  # [E, S] host support layout
+    row_entity: Array,  # device i32[n]
+    ell_idx: Array,  # device i32[n, F]
+    ell_val: Array,  # device f[n, F]
+    budget_bytes: int,
+    xsub_cache: Optional[Array] = None,
+    score_dtype=None,
+) -> tuple:
+    """Score all rows against a host-resident per-entity coefficient table by
+    streaming entity slices of the table through the device.
+
+    Returns (scores [n], x_sub cache to reuse across sweeps). The x_sub
+    densification (row features in entity-subspace layout) is itself built
+    slice-by-slice on the first call — it is row-sized [n, S], which is
+    device-resident by assumption (the ELL arrays already are).
+
+    Cost shape: each slice does O(n) row work (gather + dot) under a slice
+    mask, so a sweep's scoring is O(n * n_slices). The scoring table is only
+    E*S*itemsize bytes (no K factor), so its slice count under the same
+    budget is far smaller than the training loop's; rows are NOT regrouped
+    by slice (that would need per-slice dynamic shapes and a compile per
+    slice size)."""
+    from ..models.game import ell_support_positions
+
+    E, S = coef_values_np.shape
+    n = row_entity.shape[0]
+    itemsize = np.dtype(coef_values_np.dtype).itemsize
+    step = max(int(budget_bytes // max(S * itemsize * 2, 1)) // 8 * 8, 8)
+    if score_dtype is None:
+        score_dtype = jnp.promote_types(ell_val.dtype, jnp.float32)
+
+    if xsub_cache is None:
+        x_sub = jnp.zeros((n, S), ell_val.dtype)
+        for s0 in range(0, E, step):
+            s1 = min(s0 + step, E)
+            pc = jax.device_put(np.ascontiguousarray(proj_cols_np[s0:s1]))
+            in_sl = (row_entity >= s0) & (row_entity < s1)
+            # reuse the canonical support lookup (models/game.py): rows
+            # outside the slice resolve against entity 0's layout but their
+            # contribution is masked to zero below
+            loc = jnp.where(in_sl, row_entity - s0, 0)
+            pos, hit = ell_support_positions(pc, loc, ell_idx)
+            contrib = jnp.where(hit & in_sl[:, None], ell_val, 0.0)
+            x_sub = x_sub.at[jnp.arange(n)[:, None], pos].add(contrib)
+        xsub_cache = x_sub
+
+    xsub_wide = xsub_cache.astype(score_dtype)  # hoisted: cast once per sweep
+    scores = jnp.zeros(n, score_dtype)
+    for s0 in range(0, E, step):
+        s1 = min(s0 + step, E)
+        w = jax.device_put(np.ascontiguousarray(coef_values_np[s0:s1]))
+        in_sl = (row_entity >= s0) & (row_entity < s1)
+        loc = jnp.where(in_sl, row_entity - s0, 0)
+        wr = jnp.take(w, loc, axis=0).astype(score_dtype)  # [n, S]
+        part = jnp.sum(wr * xsub_wide, axis=1)
+        scores = scores + jnp.where(in_sl, part, 0.0)
+    return scores, xsub_cache

@@ -122,8 +122,8 @@ class RawDataset:
         ``mesh`` — see parallel/sparse.py).
 
         feature_dtype: optional narrower storage type for the FEATURE matrix
-        only (dense layout; e.g. bfloat16 halves the HBM traffic of the
-        objective sweeps on TPU). Labels/offsets/weights stay ``dtype``.
+        only (dense/ell/coo layouts; e.g. bfloat16 halves the HBM traffic of
+        the objective sweeps on TPU). Labels/offsets/weights stay ``dtype``.
         """
         import jax.numpy as jnp
 
@@ -135,10 +135,10 @@ class RawDataset:
         d = self.shard_dims[shard]
         if layout == "auto":
             layout = "dense" if d <= 4096 else "ell"
-        if feature_dtype is not None and layout != "dense":
+        if feature_dtype is not None and layout == "tiled":
             raise ValueError(
-                f"feature_dtype is only supported on the dense layout "
-                f"(got layout={layout!r})"
+                "feature_dtype is not supported on the tiled layout "
+                "(shard_map value arrays stay in the solve dtype)"
             )
         if layout == "dense":
             x = np.zeros((self.n_rows, d), dtype=np.float64)
@@ -152,6 +152,7 @@ class RawDataset:
                 rows, cols, vals, self.labels, d, self.offsets, self.weights,
                 dtype=dtype,
                 layout="coo" if layout == "coo" else "ell",
+                feature_dtype=feature_dtype,
             )
         if layout == "tiled":
             if mesh is None:

@@ -78,6 +78,45 @@ def ell_support_positions(
 
 
 @jax.jit
+def ell_row_subspace(
+    coef_indices: Array,  # i32[E, S] sorted ascending per row, -1 padded
+    entity_rows: Array,  # i32[n], -1 = unseen entity
+    feat_idx: Array,  # i32[n, F]
+    feat_val: Array,  # f[n, F]
+) -> Array:
+    """Densify each row's ELL features into its entity's subspace layout:
+    x_sub[i, s] = sum over the row's features that land at support position s.
+
+    Like :func:`ell_support_positions`, this depends only on the support
+    LAYOUT and the feature VALUES — both fixed per dataset — so it runs once
+    and is cached; every subsequent score is then a contiguous row gather of
+    the [E, S] coefficient table plus an elementwise dot
+    (:func:`score_entity_rows_dense`), instead of an n*F random 2-D gather
+    per sweep (measured ~10x at n=500k bench shapes)."""
+    pos, hit = ell_support_positions(coef_indices, entity_rows, feat_idx)
+    n = feat_idx.shape[0]
+    S = coef_indices.shape[1]
+    x_sub = jnp.zeros((n, S), feat_val.dtype)
+    return x_sub.at[jnp.arange(n)[:, None], pos].add(
+        jnp.where(hit, feat_val, 0.0)
+    )
+
+
+@jax.jit
+def score_entity_rows_dense(
+    coef_values: Array,  # f[E, S]
+    entity_rows: Array,  # i32[n], -1 = unseen entity
+    x_sub: Array,  # f[n, S] from ell_row_subspace
+) -> Array:
+    """Score with per-row subspace features already densified: one row gather
+    + masked elementwise dot."""
+    safe_rows = jnp.maximum(entity_rows, 0)
+    w = jnp.take(coef_values, safe_rows, axis=0)  # [n, S]
+    scores = jnp.sum(w * x_sub, axis=1)
+    return jnp.where(entity_rows >= 0, scores, 0.0)
+
+
+@jax.jit
 def score_entity_ell_at(
     coef_values: Array,  # f[E, S]
     entity_rows: Array,  # i32[n], -1 = unseen entity
@@ -128,6 +167,18 @@ class RandomEffectModel:
     def __post_init__(self):
         if self._id_to_row is None:
             self._id_to_row = {str(e): i for i, e in enumerate(self.entity_ids)}
+
+    def __getstate__(self):
+        # the coordinate-descent hot path tags trained models with a weakref
+        # provenance mark (_support_layout_of, game/coordinate.py) — weakrefs
+        # are unpicklable, so drop it; unpickled models fall back to the
+        # memoized array-comparison layout check
+        state = dict(self.__dict__)
+        state.pop("_support_layout_of", None)
+        return state
+
+    def __setstate__(self, state):
+        self.__dict__.update(state)
 
     @property
     def num_entities(self) -> int:

@@ -273,15 +273,20 @@ def batch_from_coo(
     max_nnz: Optional[int] = None,
     dtype=jnp.float32,
     layout: str = "ell",
+    feature_dtype=None,
 ) -> LabeledBatch:
     """Build a sparse batch from COO triplets (host-side, numpy).
 
     layout='ell' gives the row-major padded layout (moderate d);
     layout='coo' gives column-sorted COO (huge d; see module docstring).
+    ``feature_dtype`` (e.g. bfloat16) stores ONLY the feature VALUES in a
+    narrower type — indices, labels/offsets/weights and all solver state
+    stay wide; elementwise products promote back to ``dtype`` on the fly.
     """
     n = len(y)
+    vdt = feature_dtype or dtype
     if layout == "coo":
-        feats = sorted_coo_matrix(rows, cols, vals, n_rows=n, dim=dim, dtype=dtype)
+        feats = sorted_coo_matrix(rows, cols, vals, n_rows=n, dim=dim, dtype=vdt)
     else:
         counts = np.bincount(rows, minlength=n)
         k = int(max_nnz if max_nnz is not None else (counts.max() if n else 0))
@@ -299,7 +304,7 @@ def batch_from_coo(
         keep = within < k
         idx[r_s[keep], within[keep]] = c_s[keep]
         val[r_s[keep], within[keep]] = v_s[keep]
-        feats = FeatureMatrix(dim=dim, idx=jnp.asarray(idx), val=jnp.asarray(val, dtype))
+        feats = FeatureMatrix(dim=dim, idx=jnp.asarray(idx), val=jnp.asarray(val, vdt))
     return LabeledBatch(
         features=feats,
         labels=jnp.asarray(y, dtype),

@@ -37,9 +37,25 @@ from .normalization import NormalizationContext, identity_normalization
 
 Array = jax.Array
 
-# FULL variance on the tiled layout builds and inverts a [d, d] Hessian; above
-# this d the memory/inversion cost is unreasonable and SIMPLE is the answer.
-MAX_FULL_VARIANCE_DIM = 8192
+# FULL variance builds a [d, d] Hessian and Cholesky-solves it. The tiled
+# layout accumulates it model-axis-sharded (parallel/sparse.py xtcx), but the
+# factorization gathers to one device: the ceiling is that device's memory —
+# at d = 32768, the f32 matrix is 4.3 GB and the factor/solve buffers roughly
+# double it, which fits a 16 GB v5e chip. Beyond that, SIMPLE is the answer
+# (the reference densifies the same way, HessianMatrixAggregator.scala:92-128).
+MAX_FULL_VARIANCE_DIM = 32768
+
+
+def check_full_variance_dim(dim: int) -> None:
+    """Single source of truth for the FULL-variance dim ceiling: every entry
+    point (pre-solve config check and direct hessian_matrix/compute_variances
+    callers) raises the same ValueError, and raises it EARLY."""
+    if dim > MAX_FULL_VARIANCE_DIM:
+        raise ValueError(
+            f"variance=FULL needs a [d, d] Hessian factorization; d={dim} "
+            f"exceeds the supported ceiling {MAX_FULL_VARIANCE_DIM} — use "
+            "variance=SIMPLE"
+        )
 
 
 @jax.tree_util.register_dataclass
@@ -224,17 +240,21 @@ class GLMObjective:
         norm = self._norm()
         c = self._d2z_weights(coef)
         if getattr(b.features, "layout", None) == "tiled":
-            if b.dim > MAX_FULL_VARIANCE_DIM:
-                raise NotImplementedError(
-                    f"variance=FULL on the tiled layout needs a [d, d] Hessian "
-                    f"inverse; d={b.dim} exceeds the supported ceiling "
-                    f"{MAX_FULL_VARIANCE_DIM} — use variance=SIMPLE"
-                )
-            if not norm.is_identity:
-                raise NotImplementedError(
-                    "normalization is not supported with the tiled layout"
-                )
+            check_full_variance_dim(b.dim)
             h = b.features.xtcx(c)
+            if not norm.is_identity:
+                # transformed-space Hessian without densifying X:
+                #   H' = F (H - s S1^T - S1 s^T + S0 s s^T) F
+                # with F = diag(factors), s = shifts, S1 = X^T c, S0 = sum c
+                # (expand (x - s) f terms of HessianMatrixAggregator.scala:92-128)
+                if norm.shifts is not None:
+                    s1 = b.features.rmatvec(c)
+                    s0 = jnp.sum(c)
+                    sh = norm.shifts
+                    h = h - sh[:, None] * s1[None, :] - s1[:, None] * sh[None, :]
+                    h = h + s0 * sh[:, None] * sh[None, :]
+                if norm.factors is not None:
+                    h = h * norm.factors[:, None] * norm.factors[None, :]
             # pin only STRUCTURAL mesh-padding dims (>= dim_true) to unit
             # diagonal; real-but-inactive features keep the dense path's
             # behavior (their variance is governed by l2, as in the reference)
@@ -242,7 +262,7 @@ class GLMObjective:
             zeros_d = jnp.zeros(b.dim, h.dtype)
             pad_pin = (jnp.arange(b.dim) >= d_true).astype(h.dtype)
             h = h + jnp.diag(self.l2 * self._precision(zeros_d) + pad_pin)
-            return h
+            return _pin_zero_diagonal(h)
         x = b.features.to_dense()
         if norm.shifts is not None:
             x = x - norm.shifts[None, :]
@@ -250,7 +270,20 @@ class GLMObjective:
             x = x * norm.factors[None, :]
         h = x.T @ (c[:, None] * x)
         h = h + self.l2 * jnp.diag(self._precision(jnp.diagonal(h)))
-        return h
+        return _pin_zero_diagonal(h)
+
+
+def _pin_zero_diagonal(h: Array) -> Array:
+    """Pin exact-zero Hessian diagonal entries to 1 so FULL variance with
+    l2=0 and a zero-activity feature column stays invertible instead of
+    poisoning every variance with inf/nan — the same convention SIMPLE
+    variance applies to zero diagonals (compute_variances). A zero-activity
+    column has a zero row AND column, so pinning its diagonal makes it an
+    isolated unit basis vector: its own variance reads 1, others unaffected."""
+    d = h.shape[0]
+    i = jnp.arange(d)
+    dg = jnp.diagonal(h)
+    return h.at[i, i].set(jnp.where(dg == 0, jnp.ones((), h.dtype), dg))
 
 
 def _vg(obj: "GLMObjective", coef: Array):
@@ -275,7 +308,13 @@ def hvp_fn(obj: GLMObjective):
 
 @jax.jit
 def _diag_of_inverse(m: Array) -> Array:
-    return jnp.diag(jnp.linalg.inv(m))
+    # Cholesky: the (l2-regularized / zero-diag-pinned) Hessian is SPD, and
+    # the factor+solve is ~3x cheaper than LU inv at large d (the reference
+    # Cholesky-solves too, Linalg.scala)
+    from jax.scipy.linalg import cho_factor, cho_solve
+
+    cf = cho_factor(m)
+    return jnp.diag(cho_solve(cf, jnp.eye(m.shape[0], dtype=m.dtype)))
 
 
 def compute_variances(

@@ -88,6 +88,31 @@ class NormalizationContext:
             out = out / self.factors
         return out
 
+    def padded(self, dim: int) -> "NormalizationContext":
+        """Pad the stats vectors to ``dim`` with identity entries (factor 1,
+        shift 0). Mesh-tiled layouts pad the feature dim to a device multiple;
+        the reference's shift/factor algebra is layout-agnostic
+        (ValueAndGradientAggregator.scala:36-80), so padded dims simply get
+        the identity transform — they carry no data and their coefficients
+        pin at zero."""
+        if self.is_identity:
+            return self
+        d_have = (self.factors if self.factors is not None else self.shifts).shape[0]
+        if dim <= d_have:
+            return self
+        pad = dim - d_have
+
+        def _pad(v, fill):
+            return None if v is None else jnp.concatenate(
+                [v, jnp.full((pad,), fill, v.dtype)]
+            )
+
+        return NormalizationContext(
+            factors=_pad(self.factors, 1.0),
+            shifts=_pad(self.shifts, 0.0),
+            intercept_index=self.intercept_index,
+        )
+
     def effective_coefficients(self, coef: Array) -> tuple[Array, Array]:
         """(effective_coef, margin_shift) so that margin = effective_coef.x + margin_shift.
 

@@ -162,4 +162,16 @@ def abs_tolerances(
 
 
 def _norm(v: Array) -> Array:
-    return jnp.sqrt(jnp.sum(v * v))
+    # axis-0 reduction: identical to the full norm for 1-D coefficient
+    # vectors, and per-problem norms for entity-minor batched stacks [d, E]
+    return jnp.sqrt(jnp.sum(v * v, axis=0))
+
+
+def _vdot(a: Array, b: Array) -> Array:
+    """Coefficient-axis dot: scalar for 1-D operands, per-lane [E] for
+    entity-minor stacks [d, E]. 1-D keeps ``jnp.dot`` — bit-identical to the
+    historical solver path (a fused multiply+reduce associates differently,
+    which would break the vmapped path's bucket-shape exactness)."""
+    if a.ndim == 1:
+        return jnp.dot(a, b)
+    return jnp.sum(a * b, axis=0)

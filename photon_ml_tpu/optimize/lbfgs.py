@@ -24,6 +24,19 @@ single jit/vmap-safe implementation:
 Every lane of state carries a ``done`` flag; once set, all updates become
 no-ops, which is what makes ``jax.vmap(solve_lbfgs, ...)`` correct for the
 batched per-entity random-effect solves.
+
+Two batching modes serve the random-effect solve (SURVEY.md §2.1 P8):
+
+- ``vmap(solve_lbfgs)`` over entity-leading blocks ``[E, K, S]`` — the
+  original path, exact per-entity history bookkeeping;
+- ``solve_lbfgs(..., batched=True)`` over **entity-minor** stacks: ``w`` is
+  ``[S, E]`` and every reduction runs over axis 0, so the entity axis rides
+  the TPU's 128-lane dimension regardless of S. With S=32 the entity-leading
+  layout wastes 3/4 of every vector lane; entity-minor is fully packed. The
+  one semantic difference: the correction history uses a shared circular
+  cursor with per-lane validity (``rho == 0`` marks an invalid pair) instead
+  of per-lane cursors, which only diverges in the rare curvature-guard case
+  (``s.y`` too small on an improving step) — the optimum reached is the same.
 """
 
 from __future__ import annotations
@@ -38,6 +51,8 @@ from .common import (
     ConvergenceReason,
     SolverResult,
     ValueAndGradFn,
+    _norm,
+    _vdot,
     as_partial,
     check_convergence,
 )
@@ -48,8 +63,6 @@ _C1 = 1e-4  # Armijo (sufficient decrease)
 _C2 = 0.9  # curvature
 
 
-def _norm(v: Array) -> Array:
-    return jnp.sqrt(jnp.sum(v * v))
 
 
 def _pseudo_gradient(w: Array, g: Array, l1: float) -> Array:
@@ -62,14 +75,61 @@ def _pseudo_gradient(w: Array, g: Array, l1: float) -> Array:
 
 
 def _two_loop(
-    S: Array, Y: Array, rho: Array, count: Array, head: Array, g: Array
+    S: Array, Y: Array, rho: Array, count: Array, head: Array, g: Array,
+    unroll: bool = False,
 ) -> Array:
     """Two-loop recursion over a circular history buffer.
 
     S, Y: [m, d]; rho: [m]; count = #valid pairs; head = index of next write.
     Slot order from newest to oldest: head-1, head-2, ...
+
+    ``unroll=True`` (the batched entity-minor mode) runs two fully-unrolled
+    ``lax.scan``s over the history rotated into newest-first order (``roll``
+    compiles to two slices + concat, not a gather). Unrolling matters there:
+    the recursion is a dependency chain of 2m small ops, and a rolled
+    ``fori_loop`` pays ms-scale per-step scheduling overhead on [d, E] stacks
+    (measured ~11x on [32, 14k]). The vmapped/single-problem path keeps the
+    opaque ``fori_loop``: it isolates the recursion from surrounding fusion,
+    which is what keeps per-entity results bit-identical across bucket shapes
+    (tests/test_re_build.py bucketed-vs-flat exactness).
     """
     m = S.shape[0]
+
+    if unroll:
+        # rotate so index 0 is the newest pair (head - 1), 1 the next, ...
+        Sn = jnp.flip(jnp.roll(S, -head, axis=0), axis=0)
+        Yn = jnp.flip(jnp.roll(Y, -head, axis=0), axis=0)
+        rhon = jnp.flip(jnp.roll(rho, -head, axis=0), axis=0)
+        valid = jnp.arange(m) < count  # newest-first validity
+
+        def loop1s(q, x):
+            Sj, Yj, rhoj, vld = x
+            alpha = jnp.where(vld, rhoj * _vdot(Sj, q), 0.0)
+            q = q - alpha * Yj
+            return q, alpha
+
+        q, alphas = jax.lax.scan(loop1s, g, (Sn, Yn, rhon, valid), unroll=m)
+
+        # gamma from the newest pair; an invalid batched-mode pair stores
+        # zeros, so the yy > 0 guard falls back to gamma = 1 per lane
+        ys = _vdot(Sn[0], Yn[0])
+        yy = _vdot(Yn[0], Yn[0])
+        gamma = jnp.where(
+            (count > 0) & (yy > 0), ys / jnp.where(yy > 0, yy, 1.0), 1.0
+        )
+        r = gamma * q
+
+        def loop2s(r, x):
+            Sj, Yj, rhoj, vld, alpha = x
+            beta = jnp.where(vld, rhoj * _vdot(Yj, r), 0.0)
+            r = r + jnp.where(vld, alpha - beta, 0.0) * Sj
+            return r, None
+
+        # oldest to newest = reverse scan over the newest-first order
+        r, _ = jax.lax.scan(
+            loop2s, r, (Sn, Yn, rhon, valid, alphas), reverse=True, unroll=m
+        )
+        return r
 
     def newest_to_oldest(i):
         return (head - 1 - i) % m
@@ -78,18 +138,17 @@ def _two_loop(
         q, alphas = carry
         j = newest_to_oldest(i)
         valid = i < count
-        alpha = jnp.where(valid, rho[j] * jnp.dot(S[j], q), 0.0)
+        alpha = jnp.where(valid, rho[j] * _vdot(S[j], q), 0.0)
         q = q - jnp.where(valid, alpha, 0.0) * Y[j]
         return q, alphas.at[i].set(alpha)
 
     q, alphas = jax.lax.fori_loop(
-        0, m, loop1, (g, jnp.zeros(m, dtype=g.dtype))
+        0, m, loop1, (g, jnp.zeros((m,) + g.shape[1:], dtype=g.dtype))
     )
 
-    # H0 = gamma * I with gamma from the newest pair
     newest = newest_to_oldest(0)
-    ys = jnp.dot(S[newest], Y[newest])
-    yy = jnp.dot(Y[newest], Y[newest])
+    ys = _vdot(S[newest], Y[newest])
+    yy = _vdot(Y[newest], Y[newest])
     gamma = jnp.where((count > 0) & (yy > 0), ys / jnp.where(yy > 0, yy, 1.0), 1.0)
     r = gamma * q
 
@@ -98,7 +157,7 @@ def _two_loop(
         idx = m - 1 - i
         j = newest_to_oldest(idx)
         valid = idx < count
-        beta = jnp.where(valid, rho[j] * jnp.dot(Y[j], r), 0.0)
+        beta = jnp.where(valid, rho[j] * _vdot(Y[j], r), 0.0)
         r = r + jnp.where(valid, alphas[idx] - beta, 0.0) * S[j]
         return r
 
@@ -141,7 +200,8 @@ def _line_search(
     no curvature condition.
     """
     dtype = w.dtype
-    inf = jnp.asarray(jnp.inf, dtype)
+    # lane shape comes from f: () for a single problem, [E] for entity-minor
+    lanes = jnp.shape(f)
 
     def trial(t):
         w_t = w + t * direction
@@ -151,37 +211,37 @@ def _line_search(
             w_t = jnp.clip(w_t, box[0], box[1])
         f_t, g_t = value_and_grad(w_t)
         if l1 > 0.0:
-            f_t = f_t + l1 * jnp.sum(jnp.abs(w_t))
+            f_t = f_t + l1 * jnp.sum(jnp.abs(w_t), axis=0)
         return w_t, f_t, g_t
 
     w0_t, f0_t, g0_t = trial(jnp.asarray(1.0, dtype))
 
     init = _LineSearchState(
-        t=jnp.asarray(1.0, dtype),
-        lo=jnp.asarray(0.0, dtype),
-        hi=inf,
+        t=jnp.full(lanes, 1.0, dtype),
+        lo=jnp.zeros(lanes, dtype),
+        hi=jnp.full(lanes, jnp.inf, dtype),
         f_t=f0_t,
         g_t=g0_t,
         w_t=w0_t,
         it=jnp.asarray(0, jnp.int32),
-        done=jnp.asarray(False),
-        success=jnp.asarray(False),
+        done=jnp.zeros(lanes, bool),
+        success=jnp.zeros(lanes, bool),
     )
 
     def cond(s: _LineSearchState):
-        return jnp.logical_not(s.done)
+        return jnp.logical_not(jnp.all(s.done))
 
     def body(s: _LineSearchState):
         if box is not None:
-            armijo_ok = s.f_t <= f + _C1 * jnp.dot(g_plain, s.w_t - w)
+            armijo_ok = s.f_t <= f + _C1 * _vdot(g_plain, s.w_t - w)
         else:
             armijo_ok = s.f_t <= f + _C1 * s.t * dg
         if orthant is None and box is None:
             # weak Wolfe (Lewis-Overton bisection scheme): convergent under pure
             # bisection/expansion and still guarantees s.y > 0 for the history
-            curv_ok = jnp.dot(s.g_t, direction) >= _C2 * dg
+            curv_ok = _vdot(s.g_t, direction) >= _C2 * dg
         else:
-            curv_ok = jnp.asarray(True)
+            curv_ok = jnp.ones(lanes, bool)
         accept = armijo_ok & curv_ok & jnp.isfinite(s.f_t)
 
         # bracket update
@@ -219,6 +279,7 @@ class _LBFGSState(NamedTuple):
     f: Array  # objective incl. l1 term if OWL-QN
     g: Array  # plain gradient of the smooth part
     it: Array
+    k: Array  # global loop counter (scalar; == it for never-frozen lanes)
     done: Array
     reason: Array
     S: Array
@@ -238,6 +299,7 @@ class _LBFGSState(NamedTuple):
         "l1_weight",
         "max_line_search_iterations",
         "has_box",
+        "batched",
     ),
 )
 def _solve(
@@ -252,8 +314,8 @@ def _solve(
     has_box: bool,
     box_lower: Array,
     box_upper: Array,
+    batched: bool = False,
 ) -> SolverResult:
-    d = w0.shape[0]
     m = num_corrections
     dtype = w0.dtype
     box = (box_lower, box_upper) if has_box else None
@@ -262,14 +324,15 @@ def _solve(
     def full_objective(w):
         f, g = value_and_grad(w)
         if l1 > 0.0:
-            f = f + l1 * jnp.sum(jnp.abs(w))
+            f = f + l1 * jnp.sum(jnp.abs(w), axis=0)
         return f, g
 
     if box is not None:
         w0 = jnp.clip(w0, box[0], box[1])  # start feasible
     f0, g0 = full_objective(w0)
+    lanes = jnp.shape(f0)  # () single problem / [E] entity-minor batch
 
-    hist = jnp.full((max_iterations + 1,), jnp.nan, dtype)
+    hist = jnp.full((max_iterations + 1,) + lanes, jnp.nan, dtype)
 
     def effective_grad(w, g):
         if l1 > 0.0:
@@ -287,14 +350,15 @@ def _solve(
         w=w0,
         f=f0,
         g=g0,
-        it=jnp.asarray(0, jnp.int32),
-        done=jnp.asarray(False),
-        reason=jnp.asarray(0, jnp.int32),
-        S=jnp.zeros((m, d), dtype),
-        Y=jnp.zeros((m, d), dtype),
-        rho=jnp.zeros((m,), dtype),
-        count=jnp.asarray(0, jnp.int32),
-        head=jnp.asarray(0, jnp.int32),
+        it=jnp.zeros(lanes, jnp.int32),
+        k=jnp.asarray(0, jnp.int32),
+        done=jnp.zeros(lanes, bool),
+        reason=jnp.zeros(lanes, jnp.int32),
+        S=jnp.zeros((m,) + w0.shape, dtype),
+        Y=jnp.zeros((m,) + w0.shape, dtype),
+        rho=jnp.zeros((m,) + lanes, dtype),
+        count=jnp.asarray(0, jnp.int32) if batched else jnp.zeros(lanes, jnp.int32),
+        head=jnp.asarray(0, jnp.int32) if batched else jnp.zeros(lanes, jnp.int32),
         loss_history=hist.at[0].set(f0),
         grad_norm_history=hist.at[0].set(_norm(pg0)),
     )
@@ -304,15 +368,15 @@ def _solve(
 
     def body(s: _LBFGSState):
         pg = effective_grad(s.w, s.g)
-        direction = -_two_loop(s.S, s.Y, s.rho, s.count, s.head, pg)
+        direction = -_two_loop(s.S, s.Y, s.rho, s.count, s.head, pg, unroll=batched)
         if l1 > 0.0:
             # project direction into the descent orthant of -pg
             direction = jnp.where(direction * pg >= 0, 0.0, direction)
-        dg = jnp.dot(direction, pg)
+        dg = _vdot(direction, pg)
         # fall back to steepest descent if not a descent direction
         bad = dg >= 0
         direction = jnp.where(bad, -pg, direction)
-        dg = jnp.where(bad, -jnp.dot(pg, pg), dg)
+        dg = jnp.where(bad, -_vdot(pg, pg), dg)
 
         orthant = None
         if l1 > 0.0:
@@ -328,15 +392,33 @@ def _solve(
         # history update (only when improved)
         s_vec = w_new - s.w
         y_vec = g_new - s.g
-        sy = jnp.dot(s_vec, y_vec)
+        sy = _vdot(s_vec, y_vec)
         store = improved & (sy > 1e-10 * _norm(y_vec) ** 2)
-        S = jnp.where(store, s.S.at[s.head].set(s_vec), s.S)
-        Y = jnp.where(store, s.Y.at[s.head].set(y_vec), s.Y)
-        rho = jnp.where(
-            store, s.rho.at[s.head].set(1.0 / jnp.where(sy != 0, sy, 1.0)), s.rho
-        )
-        head = jnp.where(store, (s.head + 1) % m, s.head)
-        count = jnp.where(store, jnp.minimum(s.count + 1, m), s.count)
+        keep = s.done
+        if batched:
+            # shared circular cursor: every iteration writes the slot for all
+            # lanes; a lane that must not store marks its pair invalid with
+            # rho = 0 (the two-loop weights every history term by rho, so an
+            # invalid pair contributes exactly nothing, and the gamma guard
+            # falls back to 1 on all-zero newest pairs)
+            S = s.S.at[s.head].set(jnp.where(store, s_vec, 0.0))
+            Y = s.Y.at[s.head].set(jnp.where(store, y_vec, 0.0))
+            rho = s.rho.at[s.head].set(
+                jnp.where(store, 1.0 / jnp.where(sy != 0, sy, 1.0), 0.0)
+            )
+            head = (s.head + 1) % m
+            count = jnp.minimum(s.count + 1, m)
+        else:
+            S = jnp.where(store, s.S.at[s.head].set(s_vec), s.S)
+            Y = jnp.where(store, s.Y.at[s.head].set(y_vec), s.Y)
+            rho = jnp.where(
+                store, s.rho.at[s.head].set(1.0 / jnp.where(sy != 0, sy, 1.0)), s.rho
+            )
+            head = jnp.where(store & ~keep, (s.head + 1) % m, s.head)
+            count = jnp.where(store & ~keep, jnp.minimum(s.count + 1, m), s.count)
+            S = jnp.where(keep, s.S, S)
+            Y = jnp.where(keep, s.Y, Y)
+            rho = jnp.where(keep, s.rho, rho)
 
         it_new = s.it + 1
         pg_new = effective_grad(w_new, g_new)
@@ -353,31 +435,35 @@ def _solve(
         newly_done = reason != 0
 
         # masked commit: frozen lanes keep their state
-        keep = s.done
         sel = lambda a, b: jnp.where(keep, a, b)
         w_out = sel(s.w, jnp.where(improved, w_new, s.w))
         f_out = sel(s.f, jnp.where(improved, f_new, s.f))
         g_out = sel(s.g, jnp.where(improved, g_new, s.g))
         it_out = jnp.where(keep, s.it, it_new)
-        lh = jnp.where(keep, s.loss_history, s.loss_history.at[it_new].set(f_out))
-        gh = jnp.where(
-            keep,
-            s.grad_norm_history,
-            s.grad_norm_history.at[it_new].set(_norm(effective_grad(w_out, g_out))),
-        )
+        # history writes go at the global counter row (active lanes all sit at
+        # it == k): a row-mask select handles per-lane freezing without
+        # per-lane scatter indices
+        k_new = s.k + 1
+        row = (
+            jnp.arange(max_iterations + 1) == k_new
+        ).reshape((max_iterations + 1,) + (1,) * len(lanes))
+        write = row & ~keep
+        lh = jnp.where(write, f_out, s.loss_history)
+        gh = jnp.where(write, _norm(effective_grad(w_out, g_out)), s.grad_norm_history)
 
         return _LBFGSState(
             w=w_out,
             f=f_out,
             g=g_out,
             it=it_out,
+            k=k_new,
             done=keep | newly_done,
             reason=jnp.where(keep, s.reason, reason).astype(jnp.int32),
-            S=jnp.where(keep, s.S, S),
-            Y=jnp.where(keep, s.Y, Y),
-            rho=jnp.where(keep, s.rho, rho),
-            count=jnp.where(keep, s.count, count),
-            head=jnp.where(keep, s.head, head),
+            S=S,
+            Y=Y,
+            rho=rho,
+            count=count,
+            head=head,
             loss_history=lh,
             grad_norm_history=gh,
         )
@@ -405,11 +491,16 @@ def solve_lbfgs(
     l1_weight: float = 0.0,
     box_constraints: Optional[Tuple[Array, Array]] = None,
     max_line_search_iterations: int = 25,
+    batched: bool = False,
 ) -> SolverResult:
     """Minimize f(w) (+ l1*||w||_1 when ``l1_weight`` > 0) starting at w0.
 
     ``value_and_grad`` must be a pure fn of w (closing over its batch); the
     absolute tolerances come from :func:`photon_ml_tpu.optimize.common.abs_tolerances`.
+
+    ``batched=True`` solves an entity-minor stack of independent problems in
+    lockstep: ``w0`` is ``[d, E]``, ``value_and_grad`` maps ``[d, E] ->
+    ([E], [d, E])``, and the tolerances are per-lane ``[E]``.
     """
     has_box = box_constraints is not None
     zero = jnp.zeros_like(w0)
@@ -426,4 +517,5 @@ def solve_lbfgs(
         has_box,
         lower,
         upper,
+        batched,
     )

@@ -31,6 +31,8 @@ from .common import (
     HvpFn,
     SolverResult,
     ValueAndGradFn,
+    _norm,
+    _vdot,
     as_partial,
     check_convergence,
     project_box,
@@ -42,8 +44,6 @@ _ETA0, _ETA1, _ETA2 = 1e-4, 0.25, 0.75
 _SIGMA1, _SIGMA2, _SIGMA3 = 0.25, 0.5, 4.0
 
 
-def _norm(v: Array) -> Array:
-    return jnp.sqrt(jnp.sum(v * v))
 
 
 class _CGState(NamedTuple):
@@ -73,8 +73,8 @@ def _truncated_cg(
         step=jnp.zeros_like(gradient),
         residual=r0,
         direction=r0,
-        rtr=jnp.dot(r0, r0),
-        it=jnp.asarray(0, jnp.int32),
+        rtr=_vdot(r0, r0),
+        it=jnp.zeros(jnp.shape(tol), jnp.int32),
         done=_norm(r0) <= tol,
     )
 
@@ -83,15 +83,15 @@ def _truncated_cg(
 
     def body(s: _CGState):
         hd = hvp(w, s.direction)
-        dhd = jnp.dot(s.direction, hd)
+        dhd = _vdot(s.direction, hd)
         alpha = s.rtr / jnp.where(dhd != 0, dhd, 1.0)
         step_try = s.step + alpha * s.direction
 
         # Hits the trust-region boundary: back off to the boundary crossing.
         over = _norm(step_try) > delta
-        std = jnp.dot(s.step, s.direction)
-        sts = jnp.dot(s.step, s.step)
-        dtd = jnp.dot(s.direction, s.direction)
+        std = _vdot(s.step, s.direction)
+        sts = _vdot(s.step, s.step)
+        dtd = _vdot(s.direction, s.direction)
         dsq = delta * delta
         rad = jnp.sqrt(jnp.maximum(std * std + dtd * (dsq - sts), 0.0))
         alpha_b = jnp.where(
@@ -103,7 +103,7 @@ def _truncated_cg(
         step_new = s.step + alpha_eff * s.direction
         residual_new = s.residual - alpha_eff * hd
 
-        rtr_new = jnp.dot(residual_new, residual_new)
+        rtr_new = _vdot(residual_new, residual_new)
         beta = rtr_new / jnp.where(s.rtr != 0, s.rtr, 1.0)
         direction_new = residual_new + beta * s.direction
 
@@ -165,17 +165,18 @@ def _solve(
     box = (box_lower, box_upper) if has_box else None
 
     f0, g0 = value_and_grad(w0)
-    hist = jnp.full((max_iterations + 1,), jnp.nan, dtype)
+    lanes = jnp.shape(f0)  # () single problem / [E] entity-minor batch
+    hist = jnp.full((max_iterations + 1,) + lanes, jnp.nan, dtype)
 
     init = _TronState(
         w=w0,
         f=f0,
         g=g0,
         delta=_norm(g0),
-        it=jnp.asarray(0, jnp.int32),
-        failures=jnp.asarray(0, jnp.int32),
-        done=jnp.asarray(False),
-        reason=jnp.asarray(0, jnp.int32),
+        it=jnp.zeros(lanes, jnp.int32),
+        failures=jnp.zeros(lanes, jnp.int32),
+        done=jnp.zeros(lanes, bool),
+        reason=jnp.zeros(lanes, jnp.int32),
         loss_history=hist.at[0].set(f0),
         grad_norm_history=hist.at[0].set(_norm(g0)),
     )
@@ -186,8 +187,8 @@ def _solve(
     def body(s: _TronState):
         step, residual, _ = _truncated_cg(hvp, s.w, s.g, s.delta, max_cg_iterations)
         w_try = s.w + step
-        gs = jnp.dot(s.g, step)
-        predicted = -0.5 * (gs - jnp.dot(step, residual))
+        gs = _vdot(s.g, step)
+        predicted = -0.5 * (gs - _vdot(step, residual))
         f_try, g_try = value_and_grad(w_try)
         actual = s.f - f_try
         step_norm = _norm(step)
@@ -241,14 +242,18 @@ def _solve(
         newly_done = reason != 0
 
         keep = s.done
-        lh = jnp.where(
-            keep | ~accepted, s.loss_history, s.loss_history.at[it_new].set(f_new)
+        # accepted-iteration counters diverge across lanes (rejected trials
+        # don't advance it), so history writes use a row-mask select instead
+        # of per-lane scatter indices
+        row = (
+            jnp.arange(max_iterations + 1).reshape(
+                (max_iterations + 1,) + (1,) * len(lanes)
+            )
+            == it_new
         )
-        gh = jnp.where(
-            keep | ~accepted,
-            s.grad_norm_history,
-            s.grad_norm_history.at[it_new].set(_norm(g_new)),
-        )
+        write = row & accepted & ~keep
+        lh = jnp.where(write, f_new, s.loss_history)
+        gh = jnp.where(write, _norm(g_new), s.grad_norm_history)
         return _TronState(
             w=jnp.where(keep, s.w, w_new),
             f=jnp.where(keep, s.f, f_new),

@@ -142,7 +142,7 @@ class TiledSparseMatrix:
             "sharded xtcx path without materializing X)"
         )
 
-    def xtcx(self, c: Array, row_chunk: int = 4096) -> Array:
+    def xtcx(self, c: Array, row_chunk: Optional[int] = None) -> Array:
         """X^T diag(c) X -> [dim, dim], sharded over the model axis on dim 0:
         the FULL-variance Hessian on the tiled layout
         (reference: HessianMatrixAggregator.scala:92-128 — per-partition outer
@@ -160,13 +160,16 @@ class TiledSparseMatrix:
         Cost note: every scan step masks the tile's whole nnz array (entries
         are column-sorted for rmatvec's fast path, so a chunk's rows are not
         contiguous), i.e. scatter work is O(m_tile * n_chunks). To bound that
-        multiplier, ``row_chunk`` is auto-raised so n_chunks <= 64 as long as
-        the chunk's gathered rows stay under ~256 MB — a once-per-train
-        trade of memory for the serialized-scatter constant.
+        multiplier, the DEFAULT ``row_chunk`` (None) is auto-raised so
+        n_chunks <= 64 as long as the chunk's gathered rows stay under
+        ~256 MB — a once-per-train trade of memory for the serialized-scatter
+        constant. An explicitly passed ``row_chunk`` is respected as-is so
+        memory-constrained callers can cap the peak below the heuristic.
         """
         d_loc, n_loc = self.d_local, self.n_local_rows
-        mem_cap_rows = max((256 << 20) // (4 * max(self.dim, 1)), 1024)
-        row_chunk = max(row_chunk, min(-(-n_loc // 64), mem_cap_rows))
+        if row_chunk is None:
+            mem_cap_rows = max((256 << 20) // (4 * max(self.dim, 1)), 1024)
+            row_chunk = max(4096, min(-(-n_loc // 64), mem_cap_rows))
         chunk = min(row_chunk, n_loc)
         n_chunks = -(-n_loc // chunk)
         n_pad = n_chunks * chunk

@@ -107,19 +107,27 @@ def test_bf16_end_to_end_solve_reaches_f32_quality(rng, monkeypatch):
 
 def test_feature_dtype_config_validation():
     cfg = GLMOptimizationConfig(optimizer=OptimizerConfig())
-    with pytest.raises(ValueError, match="feature_dtype"):
-        GameEstimator(
-            task="logistic_regression",
-            coordinate_configs=[
-                CoordinateConfig(
-                    name="per-user",
-                    feature_shard="s",
-                    config=cfg,
-                    random_effect_type="userId",
-                    feature_dtype=jnp.bfloat16,
-                )
-            ],
-        )
+    # RE coordinates and dense/ell/coo fixed effects all ACCEPT narrow
+    # feature storage (round 5); only the tiled shard_map layout refuses
+    GameEstimator(
+        task="logistic_regression",
+        coordinate_configs=[
+            CoordinateConfig(
+                name="per-user",
+                feature_shard="s",
+                config=cfg,
+                random_effect_type="userId",
+                feature_dtype=jnp.bfloat16,
+            ),
+            CoordinateConfig(
+                name="global",
+                feature_shard="s",
+                config=cfg,
+                layout="ell",
+                feature_dtype=jnp.bfloat16,
+            ),
+        ],
+    )
     with pytest.raises(ValueError, match="feature_dtype"):
         GameEstimator(
             task="logistic_regression",
@@ -128,10 +136,11 @@ def test_feature_dtype_config_validation():
                     name="global",
                     feature_shard="s",
                     config=cfg,
-                    layout="ell",
+                    layout="tiled",
                     feature_dtype=jnp.bfloat16,
                 )
             ],
+            mesh=_mesh8(),
         )
 
 
@@ -146,3 +155,96 @@ def test_cli_coordinate_grammar_feature_dtype():
     assert cc.feature_dtype is None
     with pytest.raises(ValueError, match="feature.dtype"):
         parse_coordinate("name=global,shard=g,feature.dtype=fp8")
+
+
+def _mesh8():
+    from photon_ml_tpu.parallel import make_mesh
+
+    return make_mesh(n_data=8)
+
+
+def test_bf16_re_blocks_solve_reaches_f32_quality(rng):
+    """bf16 RE entity-block features (round-5): the packed solver promotes
+    products to f32 on the fly; final per-entity losses must be within 1% of
+    the f32-feature solve, and scoring must not truncate the residual
+    stream (VERDICT r4 missing item 5)."""
+    from photon_ml_tpu.game import (
+        GLMOptimizationConfig as GCfg,
+        RandomEffectCoordinate,
+    )
+    from photon_ml_tpu.game.data import build_random_effect_dataset
+    from photon_ml_tpu.testing import generate_mixed_effect_data
+    from photon_ml_tpu.testing.generators import mixed_data_to_raw_dataset
+
+    raw = mixed_data_to_raw_dataset(
+        generate_mixed_effect_data(
+            n=1200, d_fixed=4, re_specs={"userId": (40, 6)}, seed=9, entity_skew=1.3
+        )
+    )
+    cfg = GCfg(
+        optimizer=OptimizerConfig(tolerance=1e-8, max_iterations=100),
+        regularization=RegularizationContext("L2"),
+        reg_weight=0.5,
+    )
+    kw = dict(active_cap=64, dtype=jnp.float32)
+    ds32 = build_random_effect_dataset(raw, "re", "userShard", "userId", **kw)
+    ds16 = build_random_effect_dataset(
+        raw, "re", "userShard", "userId", feature_dtype=jnp.bfloat16, **kw
+    )
+    assert ds16.blocks.features.dtype == jnp.bfloat16
+    assert ds16.ell_val.dtype == jnp.bfloat16
+    assert ds16.blocks.labels.dtype == jnp.float32
+
+    c32 = RandomEffectCoordinate(dataset=ds32, task="logistic_regression", config=cfg)
+    c16 = RandomEffectCoordinate(dataset=ds16, task="logistic_regression", config=cfg)
+    m32, r32 = c32.train(None)
+    m16, r16 = c16.train(None)
+    # solver state stayed f32
+    assert np.asarray(m16.coef_values).dtype == np.float32
+    l32 = np.asarray(r32.loss)
+    l16 = np.asarray(r16.loss)
+    mask = l32 > 1e-8
+    assert np.all(np.abs(l16[mask] - l32[mask]) / np.maximum(l32[mask], 1e-8) < 0.01)
+
+    # scoring promotes to f32 (bf16 features, f32 coefficients)
+    s16 = c16.score(m16)
+    assert s16.dtype == jnp.float32
+    np.testing.assert_allclose(
+        np.asarray(s16), np.asarray(c32.score(m32)), atol=0.05
+    )
+
+
+def test_bf16_ell_fixed_effect_close_to_f32(rng):
+    """bf16 ELL value storage on a fixed effect: objective agrees with the
+    f32 ELL path at bf16-rounded-input precision and the solve converges to
+    comparable loss."""
+    from photon_ml_tpu.ops.features import batch_from_coo
+    from photon_ml_tpu.optimize import optimize
+
+    n, d, k = 400, 50, 5
+    rows = np.repeat(np.arange(n), k)
+    cols = rng.integers(0, d, size=n * k)
+    vals = (rng.standard_normal(n * k) * 0.4).astype(np.float64)
+    w_true = rng.standard_normal(d) * 0.3
+    x = np.zeros((n, d))
+    np.add.at(x, (rows, cols), vals)
+    y = (rng.random(n) < 1 / (1 + np.exp(-(x @ w_true)))).astype(np.float64)
+
+    b32 = batch_from_coo(rows, cols, vals, y, d, dtype=jnp.float32)
+    b16 = batch_from_coo(
+        rows, cols, vals, y, d, dtype=jnp.float32, feature_dtype=jnp.bfloat16
+    )
+    assert b16.features.val.dtype == jnp.bfloat16
+    o32 = GLMObjective(loss=LOGISTIC, batch=b32, l2=0.3)
+    o16 = GLMObjective(loss=LOGISTIC, batch=b16, l2=0.3)
+    w = jnp.asarray(rng.standard_normal(d) * 0.1, jnp.float32)
+    v32, g32 = o32.value_and_grad(w)
+    v16, g16 = o16.value_and_grad(w)
+    assert g16.dtype == jnp.float32
+    np.testing.assert_allclose(float(v16), float(v32), rtol=2e-2)
+    np.testing.assert_allclose(np.asarray(g16), np.asarray(g32), atol=0.2)
+
+    cfg = OptimizerConfig(tolerance=1e-8, max_iterations=200)
+    r32 = optimize(o32.value_and_grad, jnp.zeros(d, jnp.float32), cfg)
+    r16 = optimize(o16.value_and_grad, jnp.zeros(d, jnp.float32), cfg)
+    assert abs(float(r16.loss) - float(r32.loss)) / float(r32.loss) < 0.01

@@ -437,13 +437,16 @@ def test_full_variance_on_tiled_works_and_ceiling_fails_early(avro_paths, tmp_pa
     assert summary["configs"]
 
     # over-ceiling d: the check fires in GLMProblem.run BEFORE optimize()
+    # (round 5 raised the ceiling 8192 -> 32768 with the Cholesky path, so
+    # the over-cap probe sits above the NEW ceiling)
     import jax.numpy as jnp
     from photon_ml_tpu.game.problem import GLMOptimizationConfig, GLMProblem
+    from photon_ml_tpu.ops.glm import MAX_FULL_VARIANCE_DIM
     from photon_ml_tpu.optimize import OptimizerConfig
     from photon_ml_tpu.parallel import make_mesh
     from photon_ml_tpu.parallel.sparse import tiled_sparse_batch
 
-    n, big_d = 64, 10_000
+    n, big_d = 64, MAX_FULL_VARIANCE_DIM + 16
     rng = np.random.default_rng(0)
     rows = np.repeat(np.arange(n), 2)
     cols = rng.integers(0, big_d, 2 * n)

@@ -316,9 +316,11 @@ def test_re_score_with_reordered_model_entities(mixed):
 
 
 def test_re_score_cached_positions_match_general_path(mixed):
-    """The CD hot path caches the feature->support searchsorted once per
-    dataset (models/game.py score_entity_ell_at); it must equal the general
-    searchsorted-per-call path bit-for-bit, on first AND repeat calls."""
+    """The CD hot path densifies row features into entity-subspace layout
+    once per dataset (models/game.py ell_row_subspace); it must equal the
+    general searchsorted-per-call path (same values summed in subspace
+    instead of ELL order — f64 tolerance at 1e-12), on first AND repeat
+    calls."""
     from photon_ml_tpu.models.game import score_entity_ell
 
     data, raw = mixed
@@ -339,13 +341,13 @@ def test_re_score_cached_positions_match_general_path(mixed):
     )
     first = np.asarray(coord.score(model))
     again = np.asarray(coord.score(model))  # cache hit
-    assert getattr(ds, "_score_pos_cache", None) is not None
-    np.testing.assert_array_equal(first, general)
-    np.testing.assert_array_equal(again, general)
+    assert getattr(ds, "_score_xsub_cache", None) is not None
+    np.testing.assert_allclose(first, general, rtol=1e-12, atol=1e-12)
+    np.testing.assert_allclose(again, general, rtol=1e-12, atol=1e-12)
 
     # a second trained model (new values, same layout) reuses the cache
     model2, _ = coord.train(coord.score(model), initial_model=model)
-    np.testing.assert_array_equal(
+    np.testing.assert_allclose(
         np.asarray(coord.score(model2)),
         np.asarray(
             score_entity_ell(
@@ -356,6 +358,8 @@ def test_re_score_cached_positions_match_general_path(mixed):
                 ds.ell_val,
             )
         ),
+        rtol=1e-12,
+        atol=1e-12,
     )
 
 
@@ -373,3 +377,22 @@ def test_re_dataset_all_entities_below_lower_bound(mixed):
     coord = RandomEffectCoordinate(dataset=ds, task="logistic_regression", config=_cfg())
     m, _ = coord.train(None, None)
     np.testing.assert_allclose(np.asarray(coord.score(m)), 0.0)
+
+
+def test_random_effect_model_pickles_after_training(mixed):
+    """Trained RE models carry a weakref provenance mark for the scoring fast
+    path; pickling must drop it (weakrefs are unpicklable) and the unpickled
+    model must still score identically via the fallback layout check
+    (ADVICE r4: game/coordinate.py weakref attr)."""
+    import pickle
+
+    data, raw = mixed
+    ds = build_random_effect_dataset(raw, "per-user", "userShard", "userId")
+    coord = RandomEffectCoordinate(dataset=ds, task="logistic_regression", config=_cfg())
+    model, _ = coord.train(None, None)
+    assert getattr(model, "_support_layout_of", None) is not None
+    clone = pickle.loads(pickle.dumps(model))
+    assert not hasattr(clone, "_support_layout_of")
+    np.testing.assert_allclose(
+        np.asarray(coord.score(clone)), np.asarray(coord.score(model)), atol=1e-12
+    )

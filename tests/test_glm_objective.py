@@ -216,3 +216,23 @@ def test_variances(rng):
     h = np.asarray(obj.hessian_matrix(w))
     np.testing.assert_allclose(np.asarray(simple), 1 / np.diag(h), rtol=1e-8)
     np.testing.assert_allclose(np.asarray(full), np.diag(np.linalg.inv(h)), rtol=1e-8)
+
+
+def test_full_variance_zero_activity_column_unregularized(rng):
+    """A real-but-zero-activity feature column with l2=0 leaves a zero
+    row/col in the Hessian; the zero diagonal is pinned to 1 (the SIMPLE
+    convention) so FULL variances stay finite and the active block's
+    variances are untouched (ADVICE r4: ops/glm.py singular-inverse)."""
+    x, y, offs, wts = make_problem(rng, n=200, d=4)
+    x = np.asarray(x).copy()
+    x[:, 2] = 0.0
+    batch = batch_from_dense(x, y, offs, wts, dtype=jnp.float64)
+    obj = GLMObjective(loss=LOGISTIC, batch=batch, l2=0.0)
+    w = jnp.zeros(4)
+    full = np.asarray(compute_variances(obj, w, "FULL"))
+    assert np.all(np.isfinite(full))
+    assert full[2] == 1.0
+    # active-block variances equal the dense-submatrix inverse
+    keep = [0, 1, 3]
+    h = np.asarray(obj.hessian_matrix(w))[np.ix_(keep, keep)]
+    np.testing.assert_allclose(full[keep], np.diag(np.linalg.inv(h)), rtol=1e-8)

