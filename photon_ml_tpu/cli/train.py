@@ -327,24 +327,30 @@ def run(argv: Optional[List[str]] = None) -> Dict:
             datasets_cache["d"] = estimator.prepare_datasets(raw)
         return datasets_cache["d"]
 
-    if args.checkpoint_dir:
-        ckpt = _Checkpoint.open(args, coords, index_maps)
-        results = ckpt.fit_grid(estimator, raw, validation, get_datasets, initial_model)
-    else:
-        results = estimator.fit(
-            raw, validation=validation, initial_model=initial_model,
-            datasets=get_datasets(),
-        )
+    try:
+        if args.checkpoint_dir:
+            ckpt = _Checkpoint.open(args, coords, index_maps)
+            results = ckpt.fit_grid(
+                estimator, raw, validation, get_datasets, initial_model
+            )
+        else:
+            results = estimator.fit(
+                raw, validation=validation, initial_model=initial_model,
+                datasets=get_datasets(),
+            )
 
-    # optional hyperparameter auto-tuning (GameTrainingDriver:642-673)
-    tuned_results: List[GameResult] = []
-    if args.hyper_parameter_tuning != "NONE" and validation is not None:
-        tuned_results = _run_tuning(
-            args, estimator, raw, _resolve_validation(validation), coords,
-            results, ckpt=ckpt, datasets_fn=get_datasets,
-        )
-    if validation_pool is not None:
-        validation_pool.shutdown(wait=False)
+        # optional hyperparameter auto-tuning (GameTrainingDriver:642-673)
+        tuned_results: List[GameResult] = []
+        if args.hyper_parameter_tuning != "NONE" and validation is not None:
+            tuned_results = _run_tuning(
+                args, estimator, raw, _resolve_validation(validation), coords,
+                results, ckpt=ckpt, datasets_fn=get_datasets,
+            )
+    finally:
+        # on error paths the decode thread must not delay process exit by a
+        # full validation decode (the atexit join would wait on it)
+        if validation_pool is not None:
+            validation_pool.shutdown(wait=False, cancel_futures=True)
 
     all_results = list(results) + tuned_results
     best = estimator.select_best(all_results)

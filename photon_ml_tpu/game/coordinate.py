@@ -241,20 +241,8 @@ class RandomEffectCoordinate(Coordinate):
                 )
                 prior_prec = to_host(1.0 / jnp.maximum(var, 1e-12))
 
-        cfg = self.config
-        solver_cfg = cfg.solver_config()
-        solver_kwargs = dict(
-            task=self.task,
-            l2=cfg.regularization.l2_weight(cfg.reg_weight),
-            l1=solver_cfg.l1_weight,
-            optimizer_type=OptimizerType(solver_cfg.normalized_type()).value,
-            tolerance=solver_cfg.tolerance,
-            max_iterations=solver_cfg.max_iterations,
-            num_corrections=solver_cfg.num_corrections,
-            max_cg_iterations=solver_cfg.max_cg_iterations,
-            max_improvement_failures=solver_cfg.max_improvement_failures,
-        )
-        train_fn = _train_blocks if _re_solver_mode() == "vmapped" else _train_blocks_packed
+        solver_kwargs = self._solver_kwargs()
+        train_fn = self._train_fn()
         segments = _size_buckets(self.dataset, align=_entity_shard_align(blocks))
         if segments is None:
             results = train_fn(
@@ -310,6 +298,27 @@ class RandomEffectCoordinate(Coordinate):
         object.__setattr__(model, "_support_layout_of", weakref.ref(self.dataset))
         return model, results
 
+    def _solver_kwargs(self) -> dict:
+        """Shared static solver arguments — ONE construction site so the
+        in-memory and streamed paths cannot drift."""
+        cfg = self.config
+        solver_cfg = cfg.solver_config()
+        return dict(
+            task=self.task,
+            l2=cfg.regularization.l2_weight(cfg.reg_weight),
+            l1=solver_cfg.l1_weight,
+            optimizer_type=OptimizerType(solver_cfg.normalized_type()).value,
+            tolerance=solver_cfg.tolerance,
+            max_iterations=solver_cfg.max_iterations,
+            num_corrections=solver_cfg.num_corrections,
+            max_cg_iterations=solver_cfg.max_cg_iterations,
+            max_improvement_failures=solver_cfg.max_improvement_failures,
+        )
+
+    @staticmethod
+    def _train_fn():
+        return _train_blocks if _re_solver_mode() == "vmapped" else _train_blocks_packed
+
     def _train_streamed(
         self,
         residual_scores: Optional[Array],
@@ -345,23 +354,8 @@ class RandomEffectCoordinate(Coordinate):
                 )
                 prior_prec = (1.0 / np.maximum(var, 1e-12)).astype(sdt)
 
-        cfg = self.config
-        solver_cfg = cfg.solver_config()
-        solver_kwargs = dict(
-            task=self.task,
-            l2=cfg.regularization.l2_weight(cfg.reg_weight),
-            l1=solver_cfg.l1_weight,
-            optimizer_type=OptimizerType(solver_cfg.normalized_type()).value,
-            tolerance=solver_cfg.tolerance,
-            max_iterations=solver_cfg.max_iterations,
-            num_corrections=solver_cfg.num_corrections,
-            max_cg_iterations=solver_cfg.max_cg_iterations,
-            max_improvement_failures=solver_cfg.max_improvement_failures,
-        )
+        solver_kwargs = self._solver_kwargs()
         segments = _size_buckets(ds) or [(0, E, K, S)]
-        train_fn = (
-            _train_blocks if _re_solver_mode() == "vmapped" else _train_blocks_packed
-        )
         results = solve_streamed(
             blocks,
             segments,
@@ -370,7 +364,7 @@ class RandomEffectCoordinate(Coordinate):
             prior_mean,
             prior_prec,
             ds.hbm_budget_bytes,
-            train_fn,
+            self._train_fn(),
             solver_kwargs,
         )
         coef_indices = blocks.proj_cols
@@ -425,9 +419,13 @@ class RandomEffectCoordinate(Coordinate):
             from .streaming import score_streamed
 
             ds = self.dataset
-            same_layout = list(map(str, ds.entity_ids)) == list(
-                map(str, model.entity_ids)
-            ) and self._support_layout_matches(model)
+            # identity short-circuit: CD-trained models carry the dataset's
+            # own entity_ids array — avoid two O(E) str() list builds per
+            # sweep at streamed (big-E) scale
+            same_ids = model.entity_ids is ds.entity_ids or list(
+                map(str, ds.entity_ids)
+            ) == list(map(str, model.entity_ids))
+            same_layout = same_ids and self._support_layout_matches(model)
             sdt = np.dtype(ds.blocks.labels.dtype)  # solve/residual dtype
             if same_layout:
                 vals = np.asarray(model.coef_values, sdt)

@@ -39,11 +39,14 @@ Array = jax.Array
 
 # FULL variance builds a [d, d] Hessian and Cholesky-solves it. The tiled
 # layout accumulates it model-axis-sharded (parallel/sparse.py xtcx), but the
-# factorization gathers to one device: the ceiling is that device's memory —
-# at d = 32768, the f32 matrix is 4.3 GB and the factor/solve buffers roughly
-# double it, which fits a 16 GB v5e chip. Beyond that, SIMPLE is the answer
-# (the reference densifies the same way, HessianMatrixAggregator.scala:92-128).
-MAX_FULL_VARIANCE_DIM = 32768
+# factorization gathers to one device: the ceiling is that device's memory.
+# Measured on a 16 GB v5e chip: d = 16384 (1 GB f32 matrix) compiles and runs
+# (131s first-call incl. compile); d = 32768 OOMs — XLA's blocked
+# cholesky/triangular-solve temps peak near 10x the matrix even with the
+# chunked-RHS formulation below (40 GB needed). Beyond the cap, SIMPLE is the
+# answer (the reference densifies the same way,
+# HessianMatrixAggregator.scala:92-128).
+MAX_FULL_VARIANCE_DIM = 16384
 
 
 def check_full_variance_dim(dim: int) -> None:
@@ -308,13 +311,29 @@ def hvp_fn(obj: GLMObjective):
 
 @jax.jit
 def _diag_of_inverse(m: Array) -> Array:
-    # Cholesky: the (l2-regularized / zero-diag-pinned) Hessian is SPD, and
-    # the factor+solve is ~3x cheaper than LU inv at large d (the reference
-    # Cholesky-solves too, Linalg.scala)
-    from jax.scipy.linalg import cho_factor, cho_solve
+    """diag(m^-1) for SPD m via Cholesky (the reference Cholesky-solves too,
+    Linalg.scala): with m = L L^T, diag(m^-1)_j = ||column j of L^-1||^2.
 
-    cf = cho_factor(m)
-    return jnp.diag(cho_solve(cf, jnp.eye(m.shape[0], dtype=m.dtype)))
+    The columns of L^-1 are computed in CHUNKED triangular solves
+    (L X = I[:, j0:j1]) instead of one full-eye cho_solve: XLA's
+    triangular_solve with a [d, d] RHS materializes a d x d temp per block
+    step (measured 509 GB of HLO temps at d = 32768); a [d, chunk] RHS keeps
+    the peak at L + one chunk."""
+    d = m.shape[0]
+    L = jnp.linalg.cholesky(m)
+    chunk = min(d, 2048)
+    n_chunks = -(-d // chunk)
+
+    def body(i, diag):
+        cols = i * chunk + jnp.arange(chunk)
+        rhs = (jnp.arange(d)[:, None] == cols[None, :]).astype(m.dtype)
+        x = jax.scipy.linalg.solve_triangular(L, rhs, lower=True)  # [d, chunk]
+        return jax.lax.dynamic_update_slice(diag, jnp.sum(x * x, axis=0), (i * chunk,))
+
+    diag = jax.lax.fori_loop(
+        0, n_chunks, body, jnp.zeros(n_chunks * chunk, m.dtype)
+    )
+    return diag[:d]
 
 
 def compute_variances(

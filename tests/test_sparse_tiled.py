@@ -240,8 +240,9 @@ def test_tiled_normalization_matches_dense(rng):
 def test_full_variance_dim_ceiling_consistent(rng):
     """The FULL-variance dim ceiling raises ONE exception type (ValueError)
     from every entry point, and raises EARLY — before any solve (ADVICE r4:
-    divergent ValueError/NotImplementedError). d <= 32768 is in range now
-    (round 5 raised the 8192 cap with the Cholesky solve path)."""
+    divergent ValueError/NotImplementedError). d <= 16384 is in range now
+    (round 5 raised the 8192 cap with the chunked Cholesky solve path; 16384
+    is the measured 16 GB-chip ceiling — see ops/glm.py)."""
     from photon_ml_tpu.game.problem import GLMOptimizationConfig, GLMProblem
     from photon_ml_tpu.ops.glm import (
         MAX_FULL_VARIANCE_DIM,
@@ -249,7 +250,7 @@ def test_full_variance_dim_ceiling_consistent(rng):
     )
     from photon_ml_tpu.ops.regularization import RegularizationContext
 
-    assert MAX_FULL_VARIANCE_DIM >= 32768
+    assert MAX_FULL_VARIANCE_DIM >= 16384
     check_full_variance_dim(MAX_FULL_VARIANCE_DIM)  # in range: no raise
     with pytest.raises(ValueError, match="variance=FULL"):
         check_full_variance_dim(MAX_FULL_VARIANCE_DIM + 1)
