@@ -158,23 +158,27 @@ def bench_tpu(fe_ds, re_ds, reg=1.0, sweeps=1):
             ),
         }
         result = CoordinateDescent(coords, n_iterations=sweeps).run()
-        # true sync via scalar fetches (a full-model fetch would bill the
-        # harness's slow host link to the sweep; real deployments read the
-        # model over PCIe once at save time)
-        float(jnp.sum(result.model["per-user"].coef_values))
-        float(jnp.sum(result.model["global"].model.coefficients.means))
+        # true sync via ONE scalar fetch depending on both models (a
+        # full-model fetch would bill the harness's slow host link to the
+        # sweep, and each separate scalar fetch costs a ~100ms+ tunnel round
+        # trip; real deployments read the model over PCIe once at save time)
+        float(
+            jnp.sum(result.model["per-user"].coef_values)
+            + jnp.sum(result.model["global"].model.coefficients.means)
+        )
         return result
 
     run()  # warmup/compile
-    # Load-robust protocol (VERDICT r4 weak item 1): N timed sweeps, record
+    # Load-robust protocol (VERDICT r4 weak item 1): N timed runs, record
     # the MEDIAN as the headline plus best/worst for the spread. The harness
     # TPU shows load-dependent jitter (consecutive same-window runs vary
     # ~10%, cross-hour windows up to 2x); a single sample hands that straight
     # to the recorded number, and median-vs-best makes round-over-round
     # comparisons interpretable (a best-of-N shift is a code change, a
-    # median-only shift under a stable best is harness load). Sync is a
-    # scalar fetch per sweep — block_until_ready does not synchronize
-    # through the axon tunnel.
+    # median-only shift under a stable best is harness load). Sync is ONE
+    # scalar fetch per run — block_until_ready does not synchronize through
+    # the axon tunnel, and each fetch costs a full ~100ms+ tunnel round trip
+    # that is NOT chip time.
     walls = []
     for _ in range(5):
         t0 = time.perf_counter()
@@ -182,6 +186,38 @@ def bench_tpu(fe_ds, re_ds, reg=1.0, sweeps=1):
         walls.append(time.perf_counter() - t0)
     walls.sort()
     return walls[len(walls) // 2], {"runs_sec": [round(w, 4) for w in walls]}, result
+
+
+def bench_tpu_steady_state(fe_ds, re_ds, reg=1.0):
+    """Steady-state CD sweep time via the MARGINAL protocol: median wall of
+    2-sweep runs minus median wall of 1-sweep runs.
+
+    The subtraction cancels both the per-run sync round trip (~100ms+ over
+    this harness's tunnel; microseconds on-host) and first-sweep-only
+    overheads, leaving exactly one steady-state sweep: t2 includes sweep 1's
+    scores (they feed sweep 2's trains, so the model fetch syncs them
+    transitively) plus sweep 2's trains; t1 includes sweep 1's trains; the
+    difference is one full train+score exchange round — the quantity a
+    multi-sweep training run pays per sweep."""
+    w1, sp1, _ = bench_tpu(fe_ds, re_ds, reg=reg, sweeps=1)
+    w2, sp2, result = bench_tpu(fe_ds, re_ds, reg=reg, sweeps=2)
+    marginal = w2 - w1
+    # degenerate guard: harness load can shift between the two sequential
+    # batches (the file-top comments document ~10% same-window jitter, up to
+    # 2x across windows); a marginal below 10% of the 1-sweep wall is
+    # noise-dominated and must NOT be published as a throughput — fall back
+    # to the conservative (RTT-inclusive) 1-sweep median and say so
+    if marginal < 0.1 * w1:
+        return w1, {
+            "one_sweep": sp1,
+            "two_sweep": sp2,
+            "protocol": "FALLBACK one-sweep median (marginal was noise-dominated)",
+        }, result
+    return marginal, {
+        "one_sweep": sp1,
+        "two_sweep": sp2,
+        "protocol": "marginal (2-sweep minus 1-sweep medians)",
+    }, result
 
 
 def bench_cpu_baseline(gx, y, ex, ids, reg=1.0, entity_subsample=10):
@@ -529,7 +565,7 @@ def main():
     # jnp.asarray accepts the dtype name directly
     feature_dtype = None if a.feature_dtype == "float32" else a.feature_dtype
     fe_ds, re_ds = _glmix_datasets(gx, y, ex, ids, feature_dtype=feature_dtype)
-    wall_tpu, spread, _ = bench_tpu(fe_ds, re_ds)
+    wall_tpu, spread, _ = bench_tpu_steady_state(fe_ds, re_ds)
     examples_per_sec = n / wall_tpu
 
     gbps = _fixed_effect_bandwidth(fe_ds)
@@ -559,10 +595,15 @@ def main():
                 "value": round(examples_per_sec, 1),
                 "unit": (
                     "examples/sec/chip (n=500k, fixed d=1024 + per-user "
-                    "GLMix, 1 CD sweep; median of 5 sweeps, spread "
-                    f"{spread['runs_sec']} s best->worst; fixed-effect "
-                    f"value+grad streams {gbps:.0f} GB/s of feature data — "
-                    "GLM passes are HBM-bound GEMVs, not MXU matmuls)"
+                    "GLMix, STEADY-STATE CD sweep = median-of-5 2-sweep wall "
+                    "minus median-of-5 1-sweep wall, cancelling the per-run "
+                    "~100ms tunnel-sync round trip that is not chip time; "
+                    f"protocol: {spread['protocol']}; "
+                    f"1-sweep runs {spread['one_sweep']['runs_sec']} s, "
+                    f"2-sweep runs {spread['two_sweep']['runs_sec']} s; "
+                    f"fixed-effect value+grad streams {gbps:.0f} GB/s of "
+                    "feature data — GLM passes are HBM-bound GEMVs, not MXU "
+                    "matmuls)"
                 ),
                 "vs_baseline": round(vs_baseline, 2),
             }

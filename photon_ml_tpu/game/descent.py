@@ -139,13 +139,17 @@ class CoordinateDescent:
                 if tracker is not None:
                     trackers[name] = tracker
                     # logOptimizationSummary (CoordinateDescent.scala:230-248):
-                    # per-coordinate convergence histogram / iteration stats
-                    logger.info(
-                        "cd iter %d coordinate %s optimization summary:\n%s",
-                        it,
-                        name,
-                        tracker.to_summary_string(),
-                    )
+                    # per-coordinate convergence histogram / iteration stats.
+                    # Gated: building the summary string FETCHES device
+                    # arrays (a ~100ms+ pipeline stall per fetch on remote
+                    # links); with INFO disabled the sweep stays fetch-free
+                    if logger.isEnabledFor(logging.INFO):
+                        logger.info(
+                            "cd iter %d coordinate %s optimization summary:\n%s",
+                            it,
+                            name,
+                            tracker.to_summary_string(),
+                        )
                 models[name] = model
 
                 with timed(f"cd iter {it} coordinate {name}: score"):

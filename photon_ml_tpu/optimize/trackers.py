@@ -73,30 +73,48 @@ class FixedEffectOptimizationTracker:
 class RandomEffectOptimizationTracker:
     """Aggregate of the vmapped per-entity solves
     (RandomEffectOptimizationTracker.scala: convergence-reason counts +
-    iteration stats over entities)."""
+    iteration stats over entities).
+
+    The aggregates are LAZY: constructing a tracker must not fetch device
+    arrays — trackers are built inside the coordinate-descent hot loop every
+    sweep, and a host fetch there stalls the device pipeline for a full
+    round trip (measured ~100-165 ms through the remote-harness link). The
+    [E]-sized fetches happen on first access, typically when logs are
+    enabled or the caller inspects the finished result."""
 
     result: SolverResult
-    convergence_reasons: Dict[str, int]
-    iterations_stats: StatCounter
+    entity_mask: Optional[np.ndarray] = None
+
+    def _aggregates(self):
+        cached = self.__dict__.get("_agg")
+        if cached is None:
+            reasons = np.asarray(self.result.reason).ravel()
+            iters = np.asarray(self.result.iterations).ravel()
+            if self.entity_mask is not None:
+                mask = np.asarray(self.entity_mask, dtype=bool).ravel()
+                reasons, iters = reasons[mask], iters[mask]
+            uniq, counts = np.unique(reasons, return_counts=True)
+            hist = {
+                ConvergenceReason(int(u)).name: int(c)
+                for u, c in zip(uniq, counts)
+            }
+            cached = (hist, StatCounter.of(iters))
+            object.__setattr__(self, "_agg", cached)
+        return cached
+
+    @property
+    def convergence_reasons(self) -> Dict[str, int]:
+        return self._aggregates()[0]
+
+    @property
+    def iterations_stats(self) -> StatCounter:
+        return self._aggregates()[1]
 
     @classmethod
     def from_result(
         cls, result: SolverResult, entity_mask: Optional[np.ndarray] = None
     ) -> "RandomEffectOptimizationTracker":
-        reasons = np.asarray(result.reason).ravel()
-        iters = np.asarray(result.iterations).ravel()
-        if entity_mask is not None:
-            mask = np.asarray(entity_mask, dtype=bool).ravel()
-            reasons, iters = reasons[mask], iters[mask]
-        uniq, counts = np.unique(reasons, return_counts=True)
-        hist = {
-            ConvergenceReason(int(u)).name: int(c) for u, c in zip(uniq, counts)
-        }
-        return cls(
-            result=result,
-            convergence_reasons=hist,
-            iterations_stats=StatCounter.of(iters),
-        )
+        return cls(result=result, entity_mask=entity_mask)
 
     def to_summary_string(self) -> str:
         return (
@@ -107,13 +125,14 @@ class RandomEffectOptimizationTracker:
 
 def build_tracker(coordinate, result: Optional[SolverResult]):
     """SolverResult -> the right tracker for a coordinate (None for locked
-    ModelCoordinates, which never train)."""
+    ModelCoordinates, which never train). No device fetch happens here —
+    the reason array's NDIM distinguishes fixed (scalar) from per-entity
+    results, and shape metadata is host-known."""
     if result is None:
         return None
-    reasons = np.asarray(result.reason)
-    if reasons.ndim == 0:
+    if getattr(result.reason, "ndim", 0) == 0:
         return FixedEffectOptimizationTracker(result=result)
     dataset = getattr(coordinate, "dataset", None)
     counts = getattr(dataset, "entity_counts", None)
-    mask = None if counts is None else np.asarray(counts)[: reasons.shape[0]] > 0
+    mask = None if counts is None else np.asarray(counts)[: result.reason.shape[0]] > 0
     return RandomEffectOptimizationTracker.from_result(result, entity_mask=mask)
