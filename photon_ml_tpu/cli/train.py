@@ -536,10 +536,13 @@ class _Checkpoint:
     from its last completed sweep, and tuning resumes with the recorded
     trials re-seeded as GP observations.
 
-    Multi-process: every process loads the state, and the views are
-    allgathered and compared — a non-shared checkpoint directory (round-3
-    advisor medium finding: divergent `remaining` counts => mismatched
-    collective schedules, hang) is rejected up front. Only process 0 writes.
+    Multi-process: only process 0 writes, and its state is AUTHORITATIVE —
+    every process allgathers the state views and adopts the coordinator's
+    (warned when they differ), and checkpointed models load on the
+    coordinator and one-to-all broadcast. A shared filesystem is therefore
+    NOT required; collective schedules stay aligned because all processes
+    run the coordinator's state (round-3 advisor finding: divergent
+    `remaining` counts => mismatched collective schedules, hang).
 
     With --validation-data, best-model tracking within the in-flight combo
     restarts at the resume point: pre-crash sweeps are no longer best-model
@@ -570,13 +573,19 @@ class _Checkpoint:
             with open(state_path) as f:
                 state = json.load(f)
         if multihost.process_count() > 1:
+            # the COORDINATOR's state is authoritative: it is the only writer
+            # (process-0-only writes), so a non-shared filesystem leaves the
+            # other processes stale or empty — broadcast process 0's view
+            # instead of refusing (r3 advisor suggestion; model files are
+            # broadcast the same way in _load_model). The collective schedule
+            # stays aligned because every process now runs the same state.
             views = multihost.allgather_object(json.dumps(state, sort_keys=True))
             if len(set(views)) != 1:
-                raise SystemExit(
-                    "--checkpoint-dir with --distributed requires a SHARED "
-                    "filesystem: processes read different checkpoint states, "
-                    "which would diverge the collective schedules"
+                logger.warning(
+                    "checkpoint states differ across processes (non-shared "
+                    "filesystem); adopting the coordinator's state"
                 )
+            state = json.loads(views[0])
         if state is None:
             state = {
                 "version": 2,
@@ -625,6 +634,19 @@ class _Checkpoint:
         os.replace(self.state_path + ".tmp", self.state_path)  # atomic flip
 
     def _load_model(self, model_dir):
+        # model files exist only where the coordinator wrote them
+        # (process-0-only writes): load there, one-to-all broadcast to the
+        # others — checkpoint resume no longer requires a shared filesystem,
+        # and the payload crosses the fabric exactly once
+        if multihost.process_count() > 1:
+            model = None
+            if multihost.is_coordinator():
+                model = load_game_model(
+                    os.path.join(self.dir, model_dir),
+                    self.index_maps,
+                    task=self.args.task,
+                )
+            return multihost.broadcast_object(model)
         return load_game_model(
             os.path.join(self.dir, model_dir), self.index_maps, task=self.args.task
         )

@@ -296,13 +296,43 @@ def build_random_effect_dataset_global(
         row_entity=ent_g,
         ell_idx=eli_g,
         ell_val=elv_g,
-        # passive rows live scattered across hosts; not materialized here
-        # (info-only in the single-process build)
-        passive_rows=np.empty(0, dtype=np.int64),
+        # per-entity passive/active accounting (RandomEffectDataset.scala:
+        # 590-599): global rows that belong to a kept entity but were
+        # reservoir-dropped from its active block. Derived from the
+        # replicated plan arrays — same O(E*K + n) host cost the
+        # single-process build pays
+        passive_rows=_derive_passive_rows(
+            mesh, ent_local, raw.global_row_start or 0, active_rows
+        ),
         entity_counts=entity_counts,
         entity_subspace_dims=sizes_host,
         host_proj_cols=host_pc,
     )
+
+
+def _derive_passive_rows(mesh, ent_local, row_start, active_rows) -> np.ndarray:
+    """Global row ids that belong to a kept entity but are not in any active
+    block (the reference's passive set, RandomEffectDataset.scala:590-599).
+
+    Scalability: the [n] entity map is NOT replicated — each host tests only
+    its own local row slice (host numpy, O(n/p)) against the [E, K] active
+    table (replicated once, the same scale as the host_proj_cols table this
+    build already replicates), then the per-host PASSIVE candidates — usually
+    a small reservoir-dropped subset — are exchanged and concatenated."""
+    ar_host = np.asarray(multihost.fully_replicate(active_rows, mesh)).ravel()
+    active_ids = np.sort(ar_host[ar_host >= 0].astype(np.int64))
+    local_in_entity = (
+        row_start + np.flatnonzero(np.asarray(ent_local) >= 0)
+    ).astype(np.int64)
+    pos = np.searchsorted(active_ids, local_in_entity)
+    pos = np.minimum(pos, max(len(active_ids) - 1, 0))
+    is_active = (
+        active_ids[pos] == local_in_entity if len(active_ids) else
+        np.zeros(len(local_in_entity), bool)
+    )
+    local_passive = local_in_entity[~is_active]
+    parts = multihost.allgather_object(local_passive)
+    return np.sort(np.concatenate(parts)) if parts else local_passive
 
 
 def _pearson_select_device(

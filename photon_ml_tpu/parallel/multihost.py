@@ -150,6 +150,34 @@ def allgather_object(obj):
     ]
 
 
+def broadcast_object(obj):
+    """One-to-all broadcast of a picklable object FROM the coordinator
+    (process 0); non-coordinators' ``obj`` is ignored. Unlike
+    :func:`allgather_object` (p padded copies per host), this ships exactly
+    one copy — use it for coordinator-owned payloads like checkpointed
+    models. Single-process: returns ``obj`` unchanged."""
+    if jax.process_count() == 1:
+        return obj
+    import pickle
+
+    from jax.experimental import multihost_utils
+
+    payload = (
+        np.frombuffer(pickle.dumps(obj), dtype=np.uint8)
+        if jax.process_index() == 0
+        else np.zeros(0, np.uint8)
+    )
+    size = int(
+        multihost_utils.broadcast_one_to_all(
+            np.asarray([payload.size], np.int64)
+        )[0]
+    )
+    padded = np.zeros(size, np.uint8)
+    padded[: payload.size] = payload[:size]
+    data = multihost_utils.broadcast_one_to_all(padded)
+    return pickle.loads(np.asarray(data).tobytes())
+
+
 @functools.lru_cache(maxsize=32)
 def _replicate_fn(sharding: NamedSharding):
     # cached per sharding: jit keys on function identity, so a fresh lambda
