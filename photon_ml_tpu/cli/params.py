@@ -46,7 +46,15 @@ def parse_feature_shard(spec: str) -> Dict[str, FeatureShardConfig]:
 def parse_coordinate(spec: str) -> CoordinateConfig:
     """``name=global,shard=globalShard[,re.type=userId],optimizer=LBFGS,
     tolerance=1e-7,max.iter=100,reg.type=L2,reg.alpha=0.5,reg.weights=0.1|1|10,
-    down.sampling.rate=1.0,active.cap=256,active.lower.bound=1,variance=NONE``"""
+    down.sampling.rate=1.0,active.cap=256,active.lower.bound=1,variance=NONE,
+    features.to.samples.ratio=0.5,layout=auto,feature.dtype=bfloat16,
+    hbm.budget.mb=4096``
+
+    ``feature.dtype=bfloat16``: narrow feature storage (dense/ell/coo fixed
+    effects and RE entity blocks; solver state stays wide).
+    ``hbm.budget.mb``: out-of-core random effects — blocks above the budget
+    stay host-resident and stream through the chip in double-buffered
+    slices."""
     kv = parse_kv(spec)
     name = kv.pop("name")
     shard = kv.pop("shard")
