@@ -23,10 +23,13 @@ import json
 import logging
 import os
 import sys
+import threading
+import time
 from typing import Dict, List, Optional
 
 import numpy as np
 
+from .. import obs
 from ..estimators.game_estimator import GameEstimator, GameResult, GameTransformer
 from ..io import read_avro_dataset, save_game_model
 from ..io.index_map import IndexMap
@@ -150,6 +153,15 @@ def build_parser() -> argparse.ArgumentParser:
     )
     p.add_argument("--log-file", default=None)
     p.add_argument("--log-level", default="INFO")
+    p.add_argument(
+        "--metrics-out",
+        default=None,
+        help="directory for machine-readable run telemetry (coordinator "
+        "only): metrics.jsonl (one line per span / per-sweep metrics "
+        "flush), metrics.prom (Prometheus text exposition), and "
+        "run_summary.json (total wall time, per-coordinate iteration "
+        "stats, convergence-reason histogram)",
+    )
     return p
 
 
@@ -181,6 +193,34 @@ def run(argv: Optional[List[str]] = None) -> Dict:
             jax.local_device_count(), jax.device_count(),
         )
 
+    t_run0 = time.perf_counter()
+    run_t = None
+    prev_run = None
+    metric_sinks = []
+    if args.metrics_out and multihost.is_coordinator():
+        from ..utils.compile_cache import install_compile_metrics_hook
+
+        os.makedirs(args.metrics_out, exist_ok=True)
+        run_t = obs.RunTelemetry()
+        metric_sinks = [
+            obs.JsonlSink(os.path.join(args.metrics_out, "metrics.jsonl")),
+            obs.PrometheusSink(os.path.join(args.metrics_out, "metrics.prom")),
+        ]
+        for sink in metric_sinks:
+            run_t.register_listener(sink)
+        prev_run = obs.set_current_run(run_t)
+        install_compile_metrics_hook()
+        logger.info("run telemetry -> %s", args.metrics_out)
+    try:
+        return _run_training(args, run_t, metric_sinks, t_run0)
+    finally:
+        if run_t is not None:
+            # final flush: last metrics.jsonl line + the final metrics.prom
+            run_t.close()
+            obs.set_current_run(prev_run)
+
+
+def _run_training(args, run_t, metric_sinks, t_run0) -> Dict:
     shards = build_shard_configs(args)
     id_tags = [t for t in args.id_tags.split(",") if t]
     coord_specs = args.coordinate or [
@@ -236,7 +276,6 @@ def run(argv: Optional[List[str]] = None) -> Dict:
     logger.info("training rows: %d; shard dims: %s", raw.n_rows, raw.shard_dims)
 
     validation = None
-    validation_pool = None
     if args.validation_data:
         def _read_validation():
             v, _ = read_avro_dataset(
@@ -250,17 +289,15 @@ def run(argv: Optional[List[str]] = None) -> Dict:
             return v
 
         if multihost.process_count() == 1:
-            # ingest overlap: decode validation on a background thread (the
-            # native Avro decoder releases the GIL) while the training
+            # ingest overlap: decode validation on a background DAEMON thread
+            # (the native Avro decoder releases the GIL) while the training
             # datasets build and upload; the estimator resolves the future
             # only when the validation context is first needed
-            # (executor-parallel decode, AvroDataReader.scala:165-209)
-            import concurrent.futures
-
-            validation_pool = concurrent.futures.ThreadPoolExecutor(
-                1, thread_name_prefix="photon-val-decode"
-            )
-            validation = validation_pool.submit(_read_validation)
+            # (executor-parallel decode, AvroDataReader.scala:165-209).
+            # Daemon (vs ThreadPoolExecutor): a crash elsewhere exits bounded
+            # instead of blocking on concurrent.futures' atexit join of a
+            # decode that nobody will consume
+            validation = _DaemonFuture(_read_validation)
         else:
             # multi-process: keep the read on the main thread (collective
             # ordering across hosts must stay deterministic)
@@ -316,6 +353,10 @@ def run(argv: Optional[List[str]] = None) -> Dict:
         mesh=mesh,
         validation_frequency=args.validation_frequency,
     )
+    for sink in metric_sinks:
+        # estimator lifecycle events (TrainingStart/OptimizationLog/Finish)
+        # land in the same JSONL stream as spans and metric flushes
+        estimator.register_listener(sink)
     ckpt = None
     # datasets are reg-weight-independent: build once, lazily (an idempotent
     # rerun of a completed checkpoint must not pay the device build), and
@@ -327,7 +368,7 @@ def run(argv: Optional[List[str]] = None) -> Dict:
             datasets_cache["d"] = estimator.prepare_datasets(raw)
         return datasets_cache["d"]
 
-    try:
+    with obs.span("train"):
         if args.checkpoint_dir:
             ckpt = _Checkpoint.open(args, coords, index_maps)
             results = ckpt.fit_grid(
@@ -346,11 +387,6 @@ def run(argv: Optional[List[str]] = None) -> Dict:
                 args, estimator, raw, _resolve_validation(validation), coords,
                 results, ckpt=ckpt, datasets_fn=get_datasets,
             )
-    finally:
-        # on error paths the decode thread must not delay process exit by a
-        # full validation decode (the atexit join would wait on it)
-        if validation_pool is not None:
-            validation_pool.shutdown(wait=False, cancel_futures=True)
 
     all_results = list(results) + tuned_results
     best = estimator.select_best(all_results)
@@ -369,6 +405,16 @@ def run(argv: Optional[List[str]] = None) -> Dict:
             "metrics": None if best.evaluation is None else best.evaluation.metrics,
         },
     }
+    if run_t is not None:
+        doc = obs.build_run_summary(
+            run_t.registry, total_wall_seconds=time.perf_counter() - t_run0
+        )
+        doc["task"] = summary["task"]
+        doc["best"] = summary["best"]
+        tmp = os.path.join(args.metrics_out, "run_summary.json.tmp")
+        with open(tmp, "w") as f:
+            json.dump(doc, f, indent=2, default=float)
+        os.replace(tmp, os.path.join(args.metrics_out, "run_summary.json"))
     if not multihost.is_coordinator():
         # only process 0 writes outputs (the reference's driver-to-HDFS role)
         return summary
@@ -389,6 +435,48 @@ def run(argv: Optional[List[str]] = None) -> Dict:
         )
     logger.info("saved %d model(s) to %s", len(to_save), args.output_dir)
     return summary
+
+
+class _DaemonFuture:
+    """Future-shaped handle on a fn run in a DAEMON thread.
+
+    Replaces the ThreadPoolExecutor for the background validation decode:
+    executor threads are non-daemon and concurrent.futures joins them at
+    interpreter exit, so a training crash mid-decode used to block process
+    exit on the full decode. A daemon thread is abandoned at exit — a crash
+    anywhere exits bounded. The flip side: "cancellation" is only ever
+    not-waiting; a decode that already STARTED runs to completion in the
+    background (only not-yet-started work is effectively cancelled — here
+    the thread starts on construction, so a live decode is never killed,
+    merely never joined)."""
+
+    def __init__(self, fn):
+        self._done = threading.Event()
+        self._value = None
+        self._error = None
+
+        def _work():
+            try:
+                self._value = fn()
+            except BaseException as e:  # re-raised in result()
+                self._error = e
+            finally:
+                self._done.set()
+
+        self._thread = threading.Thread(
+            target=_work, name="photon-val-decode", daemon=True
+        )
+        self._thread.start()
+
+    def done(self) -> bool:
+        return self._done.is_set()
+
+    def result(self, timeout=None):
+        if not self._done.wait(timeout):
+            raise TimeoutError("validation decode still running")
+        if self._error is not None:
+            raise self._error
+        return self._value
 
 
 def _resolve_validation(validation):

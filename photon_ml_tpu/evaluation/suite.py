@@ -13,6 +13,7 @@ from typing import Dict, Mapping, Optional, Sequence
 
 import numpy as np
 
+from ..obs.tracing import span
 from .evaluators import Evaluator, build_evaluator, grouped_evaluate
 
 
@@ -61,11 +62,16 @@ class EvaluationSuite:
             )
         if self._device_eval is None:
             return None
-        return EvaluationResults(
-            primary_name=self.primary.name, metrics=self._device_eval(scores)
-        )
+        with span("evaluate.device"):
+            return EvaluationResults(
+                primary_name=self.primary.name, metrics=self._device_eval(scores)
+            )
 
     def evaluate(self, scores) -> EvaluationResults:
+        with span("evaluate.host"):
+            return self._evaluate_host(scores)
+
+    def _evaluate_host(self, scores) -> EvaluationResults:
         scores = np.asarray(scores, dtype=np.float64)
         out: Dict[str, float] = {}
         for ev in self.evaluators:

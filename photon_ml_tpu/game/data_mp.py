@@ -39,6 +39,9 @@ column-order tie-break keeps the SAME column on both paths (exact ties are
 common for tiny entities, e.g. four columns all scoring sqrt(6)/4). This is
 a mitigation with a vanishing — not zero — failure window: a true score
 within ~1 ulp of a grid midpoint can still round apart on the two paths.
+Tied-column parity therefore NEEDS f64: the 1e-12 grid is below f32
+resolution, so the wide scoring path requires jax_enable_x64 and refuses to
+run without it (``_require_wide_dtype``).
 """
 
 from __future__ import annotations
@@ -53,6 +56,7 @@ import numpy as np
 from jax import lax
 from jax.sharding import NamedSharding, PartitionSpec as P
 
+from .. import obs
 from ..io.data import RawDataset
 from ..parallel import multihost
 from ..parallel.mesh import DATA_AXIS
@@ -131,6 +135,19 @@ def build_random_effect_dataset_global(
 
     plan = _entity_plan(counts, active_lower_bound, active_cap, pad_entities_to_multiple)
     E_real, E, K = plan.E_real, plan.E, plan.K
+
+    # per-host build shape telemetry (host-known numbers; no device fetch)
+    reg = obs.current_run().registry
+    proc = str(multihost.process_index())
+    reg.gauge(
+        "photon_re_build_rows", "true (unpadded) local rows per process"
+    ).labels(coordinate=coordinate_id, process=proc).set(true_local)
+    reg.gauge(
+        "photon_re_build_local_entities", "distinct local entities per process"
+    ).labels(coordinate=coordinate_id, process=proc).set(len(uniq_l))
+    reg.gauge(
+        "photon_re_build_global_entities", "kept entities in the merged plan"
+    ).labels(coordinate=coordinate_id).set(E_real)
 
     # --- 2. local per-row planning columns -> global row-sharded arrays ------
     local_block = plan.old_to_block[np.searchsorted(uniq, ids_arr)]
@@ -301,18 +318,24 @@ def build_random_effect_dataset_global(
         # reservoir-dropped from its active block. Derived from the
         # replicated plan arrays — same O(E*K + n) host cost the
         # single-process build pays
-        passive_rows=_derive_passive_rows(
-            mesh, ent_local, raw.global_row_start or 0, active_rows
-        ),
+        passive_rows=_derive_passive_rows(mesh, ent_local, n_local, active_rows),
         entity_counts=entity_counts,
         entity_subspace_dims=sizes_host,
         host_proj_cols=host_pc,
     )
 
 
-def _derive_passive_rows(mesh, ent_local, row_start, active_rows) -> np.ndarray:
-    """Global row ids that belong to a kept entity but are not in any active
-    block (the reference's passive set, RandomEffectDataset.scala:590-599).
+def _derive_passive_rows(mesh, ent_local, n_local, active_rows) -> np.ndarray:
+    """PADDED-global row ids that belong to a kept entity but are not in any
+    active block (the reference's passive set, RandomEffectDataset.scala:
+    590-599).
+
+    ``active_rows`` indexes the padded global row space (local row i on
+    process p lives at ``p * n_local + i``), so the local candidates must be
+    computed in that same space. Using the TRUE global row start here is
+    wrong whenever ``n_rows`` is not divisible by the per-process chunk:
+    the pad shifts every later process's rows, active rows get misclassified
+    as passive and the returned ids don't address the dataset's row space.
 
     Scalability: the [n] entity map is NOT replicated — each host tests only
     its own local row slice (host numpy, O(n/p)) against the [E, K] active
@@ -322,7 +345,8 @@ def _derive_passive_rows(mesh, ent_local, row_start, active_rows) -> np.ndarray:
     ar_host = np.asarray(multihost.fully_replicate(active_rows, mesh)).ravel()
     active_ids = np.sort(ar_host[ar_host >= 0].astype(np.int64))
     local_in_entity = (
-        row_start + np.flatnonzero(np.asarray(ent_local) >= 0)
+        multihost.process_index() * n_local
+        + np.flatnonzero(np.asarray(ent_local) >= 0)
     ).astype(np.int64)
     pos = np.searchsorted(active_ids, local_in_entity)
     pos = np.minimum(pos, max(len(active_ids) - 1, 0))
@@ -335,6 +359,27 @@ def _derive_passive_rows(mesh, ent_local, row_start, active_rows) -> np.ndarray:
     return np.sort(np.concatenate(parts)) if parts else local_passive
 
 
+def _require_wide_dtype():
+    """The dtype the device-side Pearson scoring runs in — must be f64.
+
+    The tied-column parity scheme quantizes |score| to a 1e-12 grid
+    (``jnp.round(|score|, 12)``) so host/device reduction-order noise
+    collapses onto one sort key. f32 resolves ~7 decimal digits, so under
+    f32 the rounding is a silent no-op, near-ties rank by raw f32 noise, and
+    tied-column selection can diverge from the single-process host build.
+    Hence: wide scoring requires jax_enable_x64."""
+    wide = jnp.zeros((), jnp.float64).dtype
+    if wide != np.dtype(np.float64):
+        raise ValueError(
+            "features_to_samples_ratio on the multi-process build requires "
+            "jax_enable_x64: without f64 the 1e-12 tie-break quantization "
+            "(jnp.round(|score|, 12)) is below f32 resolution — a silent "
+            "no-op — and tied-column selection can diverge from the "
+            "single-process host path. Enable x64 or drop the ratio."
+        )
+    return wide
+
+
 def _pearson_select_device(
     mesh, ent_shard, ent_shard3, pc, feats, labels, row_mask, ratio, E_real
 ):
@@ -345,10 +390,7 @@ def _pearson_select_device(
     to the front, shrink the block subspace dim."""
     E, K, S = feats.shape
 
-    # score in the widest float available (f64 under x64) to track the
-    # single-process host computation; residual rounding can still flip
-    # near-tie ranks — immaterial to selection quality
-    wide = jnp.zeros((), jnp.float64).dtype
+    wide = _require_wide_dtype()
 
     def _keep(feats, labels, row_mask, pc):
         fw = feats.astype(wide)

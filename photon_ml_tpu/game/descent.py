@@ -21,9 +21,10 @@ from typing import Dict, List, Mapping, Optional, Sequence, Tuple
 import jax.numpy as jnp
 import numpy as np
 
+from .. import obs
 from ..evaluation.suite import EvaluationResults, EvaluationSuite
 from ..models.game import GameModel
-from ..optimize.trackers import build_tracker
+from ..optimize.trackers import build_tracker, record_tracker_metrics
 from ..utils.timed import timed
 from .coordinate import Coordinate, ModelCoordinate
 
@@ -126,51 +127,63 @@ class CoordinateDescent:
         best_models: Dict[str, object] = dict(models)
 
         for it in range(self.n_iterations):
-            for name in self.order:
-                coordinate = coords[name]
-                own = scores.get(name)
-                residual = summed - own if own is not None else summed
+            with obs.span("cd.sweep", iteration=it):
+                for name in self.order:
+                    coordinate = coords[name]
+                    own = scores.get(name)
+                    residual = summed - own if own is not None else summed
 
-                with timed(f"cd iter {it} coordinate {name}: train"):
-                    model, solver_result = coordinate.train(
-                        residual, initial_model=models.get(name)
-                    )
-                tracker = build_tracker(coordinate, solver_result)
-                if tracker is not None:
-                    trackers[name] = tracker
-                    # logOptimizationSummary (CoordinateDescent.scala:230-248):
-                    # per-coordinate convergence histogram / iteration stats.
-                    # Gated: building the summary string FETCHES device
-                    # arrays (a ~100ms+ pipeline stall per fetch on remote
-                    # links); with INFO disabled the sweep stays fetch-free
-                    if logger.isEnabledFor(logging.INFO):
-                        logger.info(
-                            "cd iter %d coordinate %s optimization summary:\n%s",
-                            it,
-                            name,
-                            tracker.to_summary_string(),
-                        )
-                models[name] = model
+                    with obs.span("cd.coordinate", iteration=it, coordinate=name):
+                        with timed(f"cd iter {it} coordinate {name}: train"):
+                            model, solver_result = coordinate.train(
+                                residual, initial_model=models.get(name)
+                            )
+                        tracker = build_tracker(coordinate, solver_result)
+                        if tracker is not None:
+                            trackers[name] = tracker
+                            # logOptimizationSummary (CoordinateDescent.scala:
+                            # 230-248): per-coordinate convergence histogram /
+                            # iteration stats. Gated: both the summary string
+                            # and the metrics recording FETCH device arrays (a
+                            # ~100ms+ pipeline stall per fetch on remote
+                            # links); with INFO disabled and no telemetry sink
+                            # the sweep stays fetch-free
+                            if logger.isEnabledFor(logging.INFO):
+                                logger.info(
+                                    "cd iter %d coordinate %s optimization "
+                                    "summary:\n%s",
+                                    it,
+                                    name,
+                                    tracker.to_summary_string(),
+                                )
+                            if obs.active():
+                                record_tracker_metrics(
+                                    obs.current_run().registry, name, tracker
+                                )
+                        models[name] = model
 
-                with timed(f"cd iter {it} coordinate {name}: score"):
-                    new_scores = coordinate.score(model)
-                # summedScores - oldScores + newScores (:441-446)
-                summed = residual + new_scores
-                scores[name] = new_scores
+                        with timed(f"cd iter {it} coordinate {name}: score"):
+                            new_scores = coordinate.score(model)
+                        # summedScores - oldScores + newScores (:441-446)
+                        summed = residual + new_scores
+                        scores[name] = new_scores
 
-                if (
-                    self.validation is not None
-                    and self.validation_frequency == "COORDINATE"
-                ):
+                        if (
+                            self.validation is not None
+                            and self.validation_frequency == "COORDINATE"
+                        ):
+                            best_eval, best_models = self._track_best(
+                                models, evaluations, best_eval, best_models, it, name
+                            )
+                if self.validation is not None and self.validation_frequency == "SWEEP":
                     best_eval, best_models = self._track_best(
-                        models, evaluations, best_eval, best_models, it, name
+                        models, evaluations, best_eval, best_models, it, self.order[-1]
                     )
-            if self.validation is not None and self.validation_frequency == "SWEEP":
-                best_eval, best_models = self._track_best(
-                    models, evaluations, best_eval, best_models, it, self.order[-1]
-                )
-            if self.checkpoint_fn is not None:
-                self.checkpoint_fn(it, dict(models))
+                if self.checkpoint_fn is not None:
+                    self.checkpoint_fn(it, dict(models))
+            if obs.active():
+                # one metrics line per sweep in the JSONL stream
+                obs.current_run().flush_metrics()
 
         final_models = best_models if best_eval is not None else models
         task = self._infer_task()
@@ -195,6 +208,13 @@ class CoordinateDescent:
         ):
             best_eval = res
             best_models = dict(models)
+        if obs.active():
+            # res.metrics values are already host floats — no extra fetch
+            gauge = obs.current_run().registry.gauge(
+                "photon_validation_metric", "validation metric after an update"
+            )
+            for metric, value in res.metrics.items():
+                gauge.labels(metric=metric, coordinate=name).set(float(value))
         logger.info("cd iter %d coordinate %s: %s", it, name, res.metrics)
         return best_eval, best_models
 

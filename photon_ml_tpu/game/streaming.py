@@ -38,25 +38,46 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from .. import obs
 from ..optimize import SolverResult
 
 Array = jax.Array
 
 
-def estimate_block_bytes(E: int, K: int, S: int, feature_itemsize: int = 4) -> int:
+def estimate_block_bytes(
+    E: int, K: int, S: int, feature_itemsize: int = 4, scalar_itemsize: int = 4
+) -> int:
     """Device bytes of an in-HBM EntityBlocks of this shape (features +
-    labels/offsets/weights + proj_cols/active_rows)."""
-    return E * K * S * feature_itemsize + 3 * E * K * 4 + E * S * 4 + E * K * 4
+    labels/offsets/weights + proj_cols/active_rows).
+
+    ``scalar_itemsize`` is the labels/offsets/weights itemsize — 8 for an
+    x64-configured dataset; callers derive it from
+    ``blocks.labels.dtype.itemsize`` (the old hardcoded 4 under-counted f64
+    datasets by up to a third)."""
+    return (
+        E * K * S * feature_itemsize
+        + 3 * E * K * scalar_itemsize
+        + E * S * 4
+        + E * K * 4
+    )
 
 
 def entities_per_slice(
-    budget_bytes: int, K: int, S: int, feature_itemsize: int = 4, multiple: int = 8
+    budget_bytes: int,
+    K: int,
+    S: int,
+    feature_itemsize: int = 4,
+    multiple: int = 8,
+    scalar_itemsize: int = 4,
 ) -> int:
     """Entities per streamed slice under ``budget_bytes``: double-buffered
-    (2 slices resident) plus ~4 [E_s, S] f32 solver-state arrays per entity
+    (2 slices resident) plus ~4 [E_s, S] solver-state arrays per entity
     lane (w0/prior/coef/grad; the L-BFGS history is bounded separately by the
-    solve itself)."""
-    per_entity = 2 * (K * S * feature_itemsize + 3 * K * 4 + S * 4 + K * 4) + 4 * S * 4
+    solve itself). Solver state follows the label dtype (``scalar_itemsize``)."""
+    per_entity = (
+        2 * (K * S * feature_itemsize + 3 * K * scalar_itemsize + S * 4 + K * 4)
+        + 4 * S * scalar_itemsize
+    )
     e = max(budget_bytes // max(per_entity, 1), multiple)
     return int(e // multiple * multiple)
 
@@ -76,14 +97,27 @@ def solve_streamed(
     host-materialized SolverResult in entity order (numpy arrays)."""
     E, K, S = blocks_np.features.shape
     feat_itemsize = blocks_np.features.dtype.itemsize
+    # solve dtype follows the dataset's labels (features may be narrower):
+    # a f64-configured streamed dataset keeps f64 results, like the in-HBM path
+    sdt = np.dtype(blocks_np.labels.dtype)
 
     # build the flat slice list: buckets split into budget-sized windows
     slices = []
     for start, end, kb, sb in segments:
-        step = max(min(entities_per_slice(budget_bytes, kb, sb, feat_itemsize), end - start), 8)
+        step = max(
+            min(
+                entities_per_slice(
+                    budget_bytes, kb, sb, feat_itemsize, scalar_itemsize=sdt.itemsize
+                ),
+                end - start,
+            ),
+            8,
+        )
         for s0 in range(start, end, step):
             s1 = min(s0 + step, end)
             slices.append((s0, s1, kb, sb))
+
+    staged_stats = {"total_bytes": 0, "max_slice_bytes": 0}
 
     def stage(sl):
         s0, s1, kb, sb = sl
@@ -97,6 +131,10 @@ def solve_streamed(
             prior_mean_np[s0:s1, :sb],
             prior_prec_np[s0:s1, :sb],
         )
+        nbytes = int(sum(a.nbytes for a in host))
+        staged_stats["total_bytes"] += nbytes
+        staged_stats["max_slice_bytes"] = max(staged_stats["max_slice_bytes"], nbytes)
+        obs.add_device_put_bytes("streaming.stage", nbytes)
         return [jax.device_put(np.ascontiguousarray(a)) for a in host]
 
     def dispatch(staged):
@@ -108,9 +146,6 @@ def solve_streamed(
             offsets = offsets + res.astype(offsets.dtype)
         return train_fn(feats, labels, offsets, weights, w0, pm, pp, **solver_kwargs)
 
-    # solve dtype follows the dataset's labels (features may be narrower):
-    # a f64-configured streamed dataset keeps f64 results, like the in-HBM path
-    sdt = np.dtype(blocks_np.labels.dtype)
     out_coef = np.zeros((E, S), sdt)
     out_grad = np.zeros((E, S), sdt)
     out_loss = np.zeros(E, sdt)
@@ -119,27 +154,92 @@ def solve_streamed(
     T = solver_kwargs["max_iterations"] + 1
     out_lh = np.full((E, T), np.nan, sdt)
     out_gh = np.full((E, T), np.nan, sdt)
+    empty_result = SolverResult(
+        coefficients=out_coef,
+        loss=out_loss,
+        gradient=out_grad,
+        iterations=out_it,
+        reason=out_reason,
+        loss_history=out_lh,
+        grad_norm_history=out_gh,
+    )
+    if not slices:
+        # every segment was empty (e.g. all entities filtered out): nothing
+        # to solve — zero coefficients, NOT_CONVERGED reasons, NaN histories
+        return empty_result
 
     def collect(sl, res):
         s0, s1, _, sb = sl
-        out_coef[s0:s1, :sb] = np.asarray(res.coefficients, sdt)
-        out_grad[s0:s1, :sb] = np.asarray(res.gradient, sdt)
-        out_loss[s0:s1] = np.asarray(res.loss, sdt)
-        out_it[s0:s1] = np.asarray(res.iterations)
-        out_reason[s0:s1] = np.asarray(res.reason)
-        out_lh[s0:s1] = np.asarray(res.loss_history, sdt)
-        out_gh[s0:s1] = np.asarray(res.grad_norm_history, sdt)
+        coef = np.asarray(res.coefficients, sdt)
+        grad = np.asarray(res.gradient, sdt)
+        loss = np.asarray(res.loss, sdt)
+        iters = np.asarray(res.iterations)
+        reason = np.asarray(res.reason)
+        lh = np.asarray(res.loss_history, sdt)
+        gh = np.asarray(res.grad_norm_history, sdt)
+        obs.add_device_fetch_bytes(
+            "streaming.collect",
+            coef.nbytes + grad.nbytes + loss.nbytes + iters.nbytes
+            + reason.nbytes + lh.nbytes + gh.nbytes,
+        )
+        out_coef[s0:s1, :sb] = coef
+        out_grad[s0:s1, :sb] = grad
+        out_loss[s0:s1] = loss
+        out_it[s0:s1] = iters
+        out_reason[s0:s1] = reason
+        out_lh[s0:s1] = lh
+        out_gh[s0:s1] = gh
 
-    staged = stage(slices[0])
-    pending = None  # (slice, dispatched result)
-    for i, sl in enumerate(slices):
-        res = dispatch(staged)  # async dispatch on the staged slice
-        if i + 1 < len(slices):
-            staged = stage(slices[i + 1])  # H2D overlaps the running solve
-        if pending is not None:
-            collect(*pending)  # fetch of slice i-1 syncs AFTER i is queued
-        pending = (sl, res)
-    collect(*pending)
+    def _staged_slice_bytes(e: int, kb: int, sb: int) -> int:
+        # what stage() actually transfers: features + labels/offsets/weights
+        # + active_rows + the w0/prior-mean/prior-precision planes (proj_cols
+        # is not staged — projection happens on the host side)
+        return (
+            e * kb * sb * feat_itemsize
+            + 3 * e * kb * sdt.itemsize
+            + e * kb * 4
+            + 3 * e * sb * sdt.itemsize
+        )
+
+    est_max_slice = max(
+        _staged_slice_bytes(s1 - s0, kb, sb) for s0, s1, kb, sb in slices
+    )
+
+    with obs.span(
+        "stream.solve", n_slices=len(slices), budget_bytes=int(budget_bytes)
+    ):
+        staged = stage(slices[0])
+        pending = None  # (slice, dispatched result)
+        for i, sl in enumerate(slices):
+            res = dispatch(staged)  # async dispatch on the staged slice
+            if i + 1 < len(slices):
+                staged = stage(slices[i + 1])  # H2D overlaps the running solve
+            if pending is not None:
+                collect(*pending)  # fetch of slice i-1 syncs AFTER i is queued
+            pending = (sl, res)
+        collect(*pending)
+
+    reg = obs.current_run().registry
+    reg.counter(
+        "photon_stream_slices_total", "streamed entity slices solved"
+    ).labels().inc(len(slices))
+    reg.counter(
+        "photon_stream_staged_bytes_total", "host bytes staged to device"
+    ).labels().inc(staged_stats["total_bytes"])
+    reg.gauge("photon_stream_budget_bytes", "configured HBM budget").labels().set(
+        budget_bytes
+    )
+    reg.gauge(
+        "photon_stream_estimated_slice_bytes",
+        "largest slice footprint by the block-byte estimator",
+    ).labels().set(est_max_slice)
+    reg.gauge(
+        "photon_stream_actual_slice_bytes", "largest slice actually staged"
+    ).labels().set(staged_stats["max_slice_bytes"])
+    reg.gauge(
+        "photon_stream_budget_headroom_bytes",
+        "budget minus double-buffered peak (negative = over budget)",
+    ).labels().set(budget_bytes - 2 * staged_stats["max_slice_bytes"])
 
     return SolverResult(
         coefficients=out_coef,

@@ -1,0 +1,67 @@
+"""Run telemetry for photon-ml-tpu: metrics registry, hierarchical span
+tracing with JAX-aware annotations, and JSONL / Prometheus sinks.
+
+Quick tour::
+
+    from photon_ml_tpu import obs
+
+    run = obs.RunTelemetry()
+    run.register_listener(obs.JsonlSink("metrics.jsonl"))
+    with obs.use_run(run):
+        with obs.span("train"):
+            ...  # spans opened here nest under "train"
+        run.flush_metrics()
+    run.close()
+
+With no sinks registered (``obs.active()`` is False) instrumentation is
+passive: cheap host-known numbers still land in the default registry, but
+nothing that would force a device fetch runs. `cli.train --metrics-out DIR`
+wires this up end to end.
+"""
+
+from .metrics import DEFAULT_BUCKETS, MetricsRegistry, render_prometheus
+from .run import (
+    MetricsSnapshotEvent,
+    RunTelemetry,
+    active,
+    build_run_summary,
+    current_run,
+    record_solver_metrics,
+    set_current_run,
+    use_run,
+)
+from .sinks import JsonlSink, PrometheusSink
+from .tracing import (
+    Span,
+    SpanEvent,
+    add_compile_seconds,
+    add_device_fetch_bytes,
+    add_device_put_bytes,
+    compile_seconds_total,
+    current_span,
+    span,
+)
+
+__all__ = [
+    "DEFAULT_BUCKETS",
+    "MetricsRegistry",
+    "MetricsSnapshotEvent",
+    "RunTelemetry",
+    "Span",
+    "SpanEvent",
+    "JsonlSink",
+    "PrometheusSink",
+    "active",
+    "add_compile_seconds",
+    "add_device_fetch_bytes",
+    "add_device_put_bytes",
+    "build_run_summary",
+    "compile_seconds_total",
+    "current_run",
+    "current_span",
+    "record_solver_metrics",
+    "render_prometheus",
+    "set_current_run",
+    "span",
+    "use_run",
+]

@@ -1,0 +1,166 @@
+"""Run-scoped telemetry: one `RunTelemetry` per training run, owning the
+metrics registry and the sink listeners.
+
+A module-global "current run" gives instrumentation sites (descent loop,
+solvers, streaming) something to record into without threading a handle
+through every call. The default current run is PASSIVE — it has a registry
+but no listeners — so instrumented code can always record cheap host-known
+numbers, while anything requiring a device fetch must gate on ``active()``.
+That is what preserves the lazy-aggregate invariant of
+``optimize/trackers.py``: with no sink registered, the CD hot loop performs
+zero additional device fetches.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import dataclasses
+from typing import List, Optional
+
+from ..utils.events import Event, EventEmitter, EventListener
+from .metrics import MetricsRegistry
+
+
+@dataclasses.dataclass(frozen=True)
+class MetricsSnapshotEvent(Event):
+    """A point-in-time registry snapshot (list of JSON-ready series dicts),
+    emitted on every ``flush_metrics`` (per CD sweep and at close)."""
+
+    metrics: List[dict]
+
+
+class RunTelemetry(EventEmitter):
+    """EventEmitter + MetricsRegistry for one training run. Sinks register
+    as listeners; ``send_event`` inherits EventEmitter's error swallowing,
+    so a raising sink can never fail training."""
+
+    def __init__(self, registry: Optional[MetricsRegistry] = None):
+        super().__init__()
+        self.registry = registry if registry is not None else MetricsRegistry()
+
+    def flush_metrics(self) -> List[dict]:
+        snap = self.registry.snapshot()
+        self.send_event(MetricsSnapshotEvent(metrics=snap))
+        return snap
+
+    def close(self) -> None:
+        if self.has_listeners():
+            self.flush_metrics()
+        self.clear_listeners()
+
+
+_current = RunTelemetry()
+
+
+def current_run() -> RunTelemetry:
+    return _current
+
+
+def set_current_run(run: Optional[RunTelemetry]) -> RunTelemetry:
+    """Install ``run`` as the current telemetry scope (None installs a fresh
+    passive one) and return the previous scope so callers can restore it."""
+    global _current
+    prev = _current
+    _current = run if run is not None else RunTelemetry()
+    return prev
+
+
+@contextlib.contextmanager
+def use_run(run: RunTelemetry):
+    prev = set_current_run(run)
+    try:
+        yield run
+    finally:
+        set_current_run(prev)
+
+
+def active() -> bool:
+    """True when some sink is listening — i.e. when it is worth paying for
+    device fetches to feed the telemetry."""
+    return _current.has_listeners()
+
+
+def record_solver_metrics(solver: str, result) -> None:
+    """Record iterations / convergence reasons / line-search failures /
+    final gradient norms for a host-level solve.
+
+    No-ops when (a) no sink is registered — the fetches below would stall
+    the device pipeline for nothing — or (b) the result leaves are tracers:
+    ``solve_lbfgs``/``solve_tron`` are also called inside the jitted
+    random-effect train functions, where there is nothing concrete to read
+    (those solves are covered by the trackers instead).
+    """
+    run = _current
+    if not run.has_listeners():
+        return
+    import jax
+
+    try:
+        tracer_cls = jax.core.Tracer
+    except AttributeError:  # pragma: no cover - newer jax moved it
+        from jax._src.core import Tracer as tracer_cls
+    if any(
+        isinstance(x, tracer_cls)
+        for x in (result.iterations, result.reason, result.gradient)
+    ):
+        return
+
+    import numpy as np
+
+    from ..optimize.common import ConvergenceReason
+    from .tracing import add_device_fetch_bytes
+
+    iters = np.asarray(result.iterations)
+    reasons = np.asarray(result.reason)
+    grad = np.asarray(result.gradient, dtype=np.float64)
+    add_device_fetch_bytes(
+        f"solver.{solver}", iters.nbytes + reasons.nbytes + grad.nbytes
+    )
+
+    reg = run.registry
+    reg.summary(
+        "photon_solver_iterations", "iterations per host-level solve"
+    ).labels(solver=solver).observe_many(iters.ravel().tolist())
+    reason_counter = reg.counter(
+        "photon_solver_convergence_reason_total",
+        "host-level solves by termination reason",
+    )
+    uniq, counts = np.unique(reasons.ravel(), return_counts=True)
+    for u, c in zip(uniq.tolist(), counts.tolist()):
+        reason_counter.labels(solver=solver, reason=ConvergenceReason(int(u)).name).inc(c)
+        if int(u) == int(ConvergenceReason.OBJECTIVE_NOT_IMPROVING):
+            # the only way the objective stops improving is the line search /
+            # trust-region step failing to find descent
+            reg.counter(
+                "photon_solver_line_search_failures_total",
+                "solves terminated because no improving step was found",
+            ).labels(solver=solver).inc(c)
+    # final gradient norm per solve: gradient is [d] for a scalar solve and
+    # [d, E] (or [d, lanes]) for batched ones — norm over axis 0 covers both
+    gn = np.sqrt((grad * grad).sum(axis=0)).ravel()
+    reg.summary(
+        "photon_solver_final_grad_norm", "final gradient norm per host-level solve"
+    ).labels(solver=solver).observe_many(gn.tolist())
+
+
+def build_run_summary(registry: MetricsRegistry, total_wall_seconds: float) -> dict:
+    """The ``run_summary.json`` document: total wall time, per-coordinate
+    iteration StatCounters and convergence-reason histograms, and the full
+    final metrics snapshot."""
+    snap = registry.snapshot()
+    coordinates: dict = {}
+    for m in snap:
+        coord = m.get("labels", {}).get("coordinate")
+        if not coord:
+            continue
+        if m["name"] == "photon_cd_iterations":
+            coordinates.setdefault(coord, {})["iterations"] = m["stat"]
+        elif m["name"] == "photon_cd_convergence_reason_total":
+            coordinates.setdefault(coord, {}).setdefault("convergence_reasons", {})[
+                m["labels"].get("reason", "?")
+            ] = int(m["value"])
+    return {
+        "total_wall_seconds": float(total_wall_seconds),
+        "coordinates": coordinates,
+        "metrics": snap,
+    }

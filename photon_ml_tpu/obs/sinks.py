@@ -1,0 +1,98 @@
+"""Telemetry sinks, implemented as `EventListener`s so the existing
+`EventEmitter` error-swallowing semantics protect training: a sink that
+raises is logged and ignored, never propagated into the training loop.
+
+- ``JsonlSink``: one JSON line per event (span close, metrics flush,
+  estimator lifecycle event), appended and flushed line-by-line so a crash
+  loses at most the line in flight.
+- ``PrometheusSink``: rewrites a text-exposition file atomically on every
+  metrics flush; the file always holds the latest complete snapshot.
+
+Serialization is fetch-free by construction: event payloads are walked
+shallowly (no ``dataclasses.asdict`` recursion, which would deep-copy the
+device arrays inside tracker/solver results) and any non-JSON value renders
+as a ``<TypeName>`` placeholder instead of ``str(value)``.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import os
+import threading
+from typing import Optional
+
+from ..utils.events import EventListener
+from .metrics import render_prometheus
+from .run import MetricsSnapshotEvent
+from .tracing import SpanEvent
+
+
+def _json_placeholder(obj) -> str:
+    return f"<{type(obj).__name__}>"
+
+
+class JsonlSink(EventListener):
+    """Crash-safe JSONL event/metric writer (append + per-line flush)."""
+
+    def __init__(self, path: str):
+        self.path = path
+        self._lock = threading.Lock()
+        self._f: Optional[object] = open(path, "a", encoding="utf-8")
+
+    def handle(self, event) -> None:
+        payload = self._payload(event)
+        line = json.dumps(payload, default=_json_placeholder)
+        with self._lock:
+            if self._f is None:
+                return
+            self._f.write(line + "\n")
+            self._f.flush()
+
+    @staticmethod
+    def _payload(event) -> dict:
+        if isinstance(event, SpanEvent):
+            s = event.span
+            return {
+                "type": "span",
+                "name": s.name,
+                "span_id": s.span_id,
+                "parent_id": s.parent_id,
+                "start_unix": s.start_unix,
+                "duration_s": s.duration_s,
+                "attrs": s.attrs,
+            }
+        if isinstance(event, MetricsSnapshotEvent):
+            return {"type": "metrics", "metrics": event.metrics}
+        body = {}
+        if dataclasses.is_dataclass(event):
+            # shallow on purpose: OptimizationLogEvent holds trackers whose
+            # solver results are device arrays — recursing would fetch them
+            for f in dataclasses.fields(event):
+                body[f.name] = getattr(event, f.name)
+        return {"type": "event", "event": type(event).__name__, **body}
+
+    def close(self) -> None:
+        with self._lock:
+            if self._f is not None:
+                self._f.close()
+                self._f = None
+
+
+class PrometheusSink(EventListener):
+    """Prometheus text-exposition dump, atomically rewritten per flush."""
+
+    def __init__(self, path: str):
+        self.path = path
+
+    def handle(self, event) -> None:
+        if not isinstance(event, MetricsSnapshotEvent):
+            return
+        text = render_prometheus(event.metrics)
+        tmp = self.path + ".tmp"
+        with open(tmp, "w", encoding="utf-8") as f:
+            f.write(text)
+        os.replace(tmp, self.path)
+
+    def close(self) -> None:
+        pass

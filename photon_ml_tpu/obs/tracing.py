@@ -1,0 +1,118 @@
+"""Hierarchical span tracing with JAX-aware annotations.
+
+Spans nest through a contextvar (so the tree survives generators and is
+isolated per thread / async context), carry wall time, and pick up two kinds
+of annotation:
+
+- compile seconds, fed by the jax monitoring hook installed via
+  ``utils.compile_cache.install_compile_metrics_hook`` — a span whose body
+  triggered XLA compilation reports ``compile_s`` alongside its wall time,
+  separating compile from execute cost;
+- device-transfer byte counters (``add_device_fetch_bytes`` /
+  ``add_device_put_bytes``), called at the known host<->device crossing
+  points (tracker aggregation, streamed staging/collection).
+
+Span exit emits a ``SpanEvent`` through the current run's EventEmitter, so a
+raising sink cannot fail the traced code path; with no sinks the span is
+pure host bookkeeping (a perf_counter pair and a dict).
+"""
+
+from __future__ import annotations
+
+import contextlib
+import contextvars
+import dataclasses
+import itertools
+import threading
+import time
+from typing import Dict, Optional
+
+from ..utils.events import Event
+from . import run as _run
+
+_ctx: contextvars.ContextVar = contextvars.ContextVar("photon_obs_span", default=None)
+_ids = itertools.count(1)
+
+# process-wide compile-time accumulator, fed by the jax monitoring hook;
+# spans snapshot it on entry to attribute compile seconds to themselves
+_compile_lock = threading.Lock()
+_compile_seconds_total = 0.0
+
+
+def add_compile_seconds(seconds: float) -> None:
+    global _compile_seconds_total
+    with _compile_lock:
+        _compile_seconds_total += float(seconds)
+
+
+def compile_seconds_total() -> float:
+    with _compile_lock:
+        return _compile_seconds_total
+
+
+@dataclasses.dataclass
+class Span:
+    name: str
+    span_id: str
+    parent_id: Optional[str]
+    start_unix: float
+    attrs: Dict[str, object]
+    duration_s: Optional[float] = None
+
+
+@dataclasses.dataclass(frozen=True)
+class SpanEvent(Event):
+    span: Span
+
+
+def current_span() -> Optional[Span]:
+    return _ctx.get()
+
+
+@contextlib.contextmanager
+def span(name: str, **attrs):
+    """Open a span named ``name``; nests under the current span if any."""
+    parent = _ctx.get()
+    s = Span(
+        name=name,
+        span_id=f"s{next(_ids)}",
+        parent_id=parent.span_id if parent is not None else None,
+        start_unix=time.time(),
+        attrs=dict(attrs),
+    )
+    token = _ctx.set(s)
+    compile0 = compile_seconds_total()
+    t0 = time.perf_counter()
+    try:
+        yield s
+    finally:
+        s.duration_s = time.perf_counter() - t0
+        compile_delta = compile_seconds_total() - compile0
+        if compile_delta > 0:
+            s.attrs["compile_s"] = compile_delta
+        _ctx.reset(token)
+        run = _run.current_run()
+        if run.has_listeners():
+            run.send_event(SpanEvent(span=s))
+
+
+def _add_transfer_bytes(direction: str, site: str, nbytes: int) -> None:
+    nbytes = int(nbytes)
+    _run.current_run().registry.counter(
+        f"photon_device_{direction}_bytes_total",
+        f"bytes transferred at instrumented device-{direction} sites",
+    ).labels(site=site).inc(nbytes)
+    s = _ctx.get()
+    if s is not None:
+        key = f"{direction}_bytes"
+        s.attrs[key] = int(s.attrs.get(key, 0)) + nbytes
+
+
+def add_device_fetch_bytes(site: str, nbytes: int) -> None:
+    """Count a device->host fetch (nbytes is host-known: no extra sync)."""
+    _add_transfer_bytes("fetch", site, nbytes)
+
+
+def add_device_put_bytes(site: str, nbytes: int) -> None:
+    """Count a host->device transfer."""
+    _add_transfer_bytes("put", site, nbytes)

@@ -47,6 +47,7 @@ from typing import NamedTuple, Optional, Tuple
 import jax
 import jax.numpy as jnp
 
+from .. import obs
 from .common import (
     ConvergenceReason,
     SolverResult,
@@ -505,7 +506,7 @@ def solve_lbfgs(
     has_box = box_constraints is not None
     zero = jnp.zeros_like(w0)
     lower, upper = box_constraints if has_box else (zero, zero)
-    return _solve(
+    result = _solve(
         as_partial(value_and_grad),
         w0,
         jnp.asarray(loss_abs_tol, w0.dtype),
@@ -519,3 +520,5 @@ def solve_lbfgs(
         upper,
         batched,
     )
+    obs.record_solver_metrics("lbfgs", result)
+    return result

@@ -17,6 +17,7 @@ from typing import Dict, Optional
 
 import numpy as np
 
+from ..obs import tracing
 from .common import ConvergenceReason, SolverResult
 
 
@@ -90,6 +91,9 @@ class RandomEffectOptimizationTracker:
         if cached is None:
             reasons = np.asarray(self.result.reason).ravel()
             iters = np.asarray(self.result.iterations).ravel()
+            tracing.add_device_fetch_bytes(
+                "tracker_aggregates", reasons.nbytes + iters.nbytes
+            )
             if self.entity_mask is not None:
                 mask = np.asarray(self.entity_mask, dtype=bool).ravel()
                 reasons, iters = reasons[mask], iters[mask]
@@ -136,3 +140,35 @@ def build_tracker(coordinate, result: Optional[SolverResult]):
     counts = getattr(dataset, "entity_counts", None)
     mask = None if counts is None else np.asarray(counts)[: result.reason.shape[0]] > 0
     return RandomEffectOptimizationTracker.from_result(result, entity_mask=mask)
+
+
+def record_tracker_metrics(registry, coordinate_name: str, tracker) -> None:
+    """Fold one coordinate update's tracker into the metrics registry:
+    ``photon_cd_iterations`` (StatCounter-compatible summary) and
+    ``photon_cd_convergence_reason_total`` per coordinate. Forces the
+    tracker's lazy aggregates, so callers in the CD hot loop must gate this
+    on ``obs.active()``."""
+    if tracker is None:
+        return
+    iters = registry.summary(
+        "photon_cd_iterations", "solver iterations per coordinate update"
+    ).labels(coordinate=coordinate_name)
+    reasons = registry.counter(
+        "photon_cd_convergence_reason_total",
+        "coordinate-update solves by termination reason",
+    )
+    if isinstance(tracker, RandomEffectOptimizationTracker):
+        st = tracker.iterations_stats
+        iters.merge_stat(st.count, st.mean, st.stdev, st.max, st.min)
+        for reason, n in tracker.convergence_reasons.items():
+            reasons.labels(coordinate=coordinate_name, reason=reason).inc(n)
+    else:
+        r = tracker.result
+        iters.observe(int(np.asarray(r.iterations)))
+        reasons.labels(
+            coordinate=coordinate_name,
+            reason=ConvergenceReason(int(np.asarray(r.reason))).name,
+        ).inc()
+        registry.gauge(
+            "photon_cd_final_loss", "final training loss of the latest update"
+        ).labels(coordinate=coordinate_name).set(float(np.asarray(r.loss)))

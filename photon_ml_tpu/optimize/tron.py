@@ -27,6 +27,7 @@ from typing import NamedTuple, Optional, Tuple
 import jax
 import jax.numpy as jnp
 
+from .. import obs
 from .common import (
     HvpFn,
     SolverResult,
@@ -293,7 +294,7 @@ def solve_tron(
     has_box = box_constraints is not None
     zero = jnp.zeros_like(w0)
     lower, upper = box_constraints if has_box else (zero, zero)
-    return _solve(
+    result = _solve(
         as_partial(value_and_grad),
         as_partial(hvp),
         w0,
@@ -306,3 +307,5 @@ def solve_tron(
         lower,
         upper,
     )
+    obs.record_solver_metrics("tron", result)
+    return result

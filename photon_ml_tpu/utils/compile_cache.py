@@ -39,3 +39,43 @@ def enable_persistent_compilation_cache(path: str | None = None) -> str | None:
         logger.info("persistent compilation cache unavailable: %s", e)
         return None
     return path
+
+
+_compile_hook_installed = False
+
+
+def install_compile_metrics_hook() -> bool:
+    """Best-effort: register a jax monitoring listener that feeds XLA
+    compile durations into the obs layer (span ``compile_s`` attribution
+    plus ``photon_jax_compile_*`` registry series). Idempotent; returns
+    True when the hook is (already) installed."""
+    global _compile_hook_installed
+    if _compile_hook_installed:
+        return True
+    try:
+        from jax._src import monitoring
+    except Exception as e:  # private API: degrade to no compile attribution
+        logger.info("jax monitoring hook unavailable: %s", e)
+        return False
+
+    from .. import obs
+
+    def _on_duration(event: str, duration: float, **kwargs) -> None:
+        if "compile" not in event:
+            return
+        obs.add_compile_seconds(duration)
+        reg = obs.current_run().registry
+        reg.counter(
+            "photon_jax_compile_total", "XLA compile events by jax event name"
+        ).labels(event=event).inc()
+        reg.summary(
+            "photon_jax_compile_seconds", "XLA compile seconds by jax event name"
+        ).labels(event=event).observe(duration)
+
+    try:
+        monitoring.register_event_duration_secs_listener(_on_duration)
+    except Exception as e:
+        logger.info("jax monitoring hook registration failed: %s", e)
+        return False
+    _compile_hook_installed = True
+    return True

@@ -70,6 +70,14 @@ class EventEmitter:
         with self._lock:
             self._listeners.append(listener)
 
+    def has_listeners(self) -> bool:
+        with self._lock:
+            return bool(self._listeners)
+
+    def listeners(self) -> List[EventListener]:
+        with self._lock:
+            return list(self._listeners)
+
     def clear_listeners(self) -> None:
         with self._lock:
             for l in self._listeners:

@@ -1,11 +1,19 @@
 """Named wall-clock sections (reference: photon-lib .../util/Timed.scala:33-83,
-used at every driver/estimator stage)."""
+used at every driver/estimator stage).
+
+``timed`` keeps its historical log line but now also opens an ``obs`` span of
+the same name, so every existing timed section participates in hierarchical
+tracing (parent/child nesting, compile-second attribution, sink output) for
+free. With no telemetry sinks registered the span is pure host bookkeeping.
+"""
 
 from __future__ import annotations
 
 import contextlib
 import logging
 import time
+
+from ..obs.tracing import span
 
 logger = logging.getLogger("photon_ml_tpu")
 
@@ -14,6 +22,7 @@ logger = logging.getLogger("photon_ml_tpu")
 def timed(name: str, level: int = logging.DEBUG):
     t0 = time.perf_counter()
     try:
-        yield
+        with span(name):
+            yield
     finally:
         logger.log(level, "%s took %.3fs", name, time.perf_counter() - t0)

@@ -885,3 +885,154 @@ def test_two_process_checkpoint_resume_without_shared_fs(tmp_path):
     assert any(
         "2/2 configurations already trained" in err for _, _, err in outs
     ), "resume did not recognize the completed grid from the coordinator state"
+
+
+_PASSIVE_WORKER = """
+import sys
+import numpy as np
+import jax
+jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_enable_x64", True)
+# cross-host collectives on the CPU backend need an explicit implementation
+jax.config.update("jax_cpu_collectives_implementation", "gloo")
+
+from photon_ml_tpu.parallel import make_mesh, multihost
+
+spec, n_total = sys.argv[1], int(sys.argv[2])
+multihost.initialize_from_spec(spec)
+
+from photon_ml_tpu.game.data_mp import build_random_effect_dataset_global
+from photon_ml_tpu.io.data import RawDataset
+
+r0, r1 = multihost.host_row_range(n_total)
+n_loc = r1 - r0
+g = np.arange(r0, r1)
+d = 2
+raw = RawDataset(
+    n_rows=n_loc,
+    labels=np.asarray(g % 2, np.float64),
+    offsets=np.zeros(n_loc),
+    weights=np.ones(n_loc),
+    shard_coo={
+        "userShard": (
+            np.repeat(np.arange(n_loc), d),
+            np.tile(np.arange(d), n_loc),
+            np.linspace(0.1, 1.0, n_loc * d),
+        )
+    },
+    shard_dims={"userShard": d},
+    id_tags={"userId": np.array(["u%d" % (x % 3) for x in g], dtype=object)},
+    global_row_start=r0,
+)
+raw = raw.pad_rows(multihost.equal_host_share(n_total))
+mesh = make_mesh(n_data=8, n_model=1)
+
+# the regression needs the PADDED local row space to differ from the true
+# one: chunk = 8 devices / 2 procs = 4, so 11 local rows pad to 12
+chunk = max(8 // jax.process_count(), 1)
+n_local = ((raw.n_rows + chunk - 1) // chunk) * chunk
+assert n_local != raw.n_rows, (n_local, raw.n_rows)
+
+ds = build_random_effect_dataset_global(
+    raw, "re", "userShard", "userId", mesh=mesh, active_cap=2,
+    pad_entities_to_multiple=8,
+)
+
+# ground truth from the padded-global entity map: every row that belongs to
+# a kept entity is either in an active block or passive — exactly once
+ent_g = np.asarray(multihost.fully_replicate(ds.row_entity, mesh))
+in_entity = np.flatnonzero(ent_g >= 0).astype(np.int64)
+ar = np.asarray(multihost.fully_replicate(ds.blocks.active_rows, mesh)).ravel()
+active = np.sort(ar[ar >= 0].astype(np.int64))
+union = np.sort(np.concatenate([active, ds.passive_rows]))
+assert np.array_equal(union, in_entity), (union.tolist(), in_entity.tolist())
+assert len(np.intersect1d(active, ds.passive_rows)) == 0
+print("PASSIVE_OK", jax.process_index(), len(ds.passive_rows))
+"""
+
+
+@pytest.mark.slow
+def test_two_process_passive_rows_padded_space(tmp_path):
+    """Satellite regression: _derive_passive_rows used to compare TRUE-global
+    row ids against the PADDED-space active_rows table. With 21 rows on 2
+    processes (host shares 11/10, padded to 11, chunk 4 -> n_local 12) every
+    host-1 row id was off by the pad shift, so active rows were misclassified
+    as passive. 3 users x 7 rows with active_cap=2 must yield exactly
+    3 * (7 - 2) = 15 passive rows, disjoint from the active set, and the
+    active/passive union must be exactly the rows mapped to a kept entity."""
+    n_total = 21  # not divisible by chunk=4: host 1's padded ids shift by 1
+    port = _free_port()
+    env = {**os.environ, "PYTHONPATH": REPO}
+    # 4 virtual CPU devices per process (jax 0.4.x spells this via XLA_FLAGS)
+    env["XLA_FLAGS"] = "--xla_force_host_platform_device_count=4"
+    procs = [
+        subprocess.Popen(
+            [
+                sys.executable, "-c", _PASSIVE_WORKER,
+                f"coordinator=localhost:{port},process={i},n=2",
+                str(n_total),
+            ],
+            env=env, cwd=REPO,
+            stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True,
+        )
+        for i in range(2)
+    ]
+    outs = []
+    for p in procs:
+        try:
+            out, err = p.communicate(timeout=420)
+        except subprocess.TimeoutExpired:
+            for q in procs:
+                q.kill()
+            pytest.fail("2-process passive-rows build timed out")
+        outs.append((p.returncode, out, err))
+    for rc, out, err in outs:
+        assert rc == 0, f"worker failed:\n{out}\n{err}"
+        assert "PASSIVE_OK" in out
+    counts = {
+        int(l.split()[2])
+        for _, out, _ in outs
+        for l in out.splitlines()
+        if l.startswith("PASSIVE_OK")
+    }
+    assert counts == {15}, counts
+
+
+def test_single_process_passive_rows_partition():
+    """Fast single-process counterpart of the padded-space regression: with 8
+    virtual devices chunk=8, so 21 rows pad to 24 — active_rows and passive
+    rows must still partition exactly the rows mapped to a kept entity."""
+    from photon_ml_tpu.game.data_mp import build_random_effect_dataset_global
+    from photon_ml_tpu.io.data import RawDataset
+    from photon_ml_tpu.parallel import make_mesh
+
+    n = 21
+    g = np.arange(n)
+    d = 2
+    raw = RawDataset(
+        n_rows=n,
+        labels=np.asarray(g % 2, np.float64),
+        offsets=np.zeros(n),
+        weights=np.ones(n),
+        shard_coo={
+            "userShard": (
+                np.repeat(np.arange(n), d),
+                np.tile(np.arange(d), n),
+                np.linspace(0.1, 1.0, n * d),
+            )
+        },
+        shard_dims={"userShard": d},
+        id_tags={"userId": np.array([f"u{x % 3}" for x in g], dtype=object)},
+        global_row_start=0,
+    )
+    ds = build_random_effect_dataset_global(
+        raw, "re", "userShard", "userId", mesh=make_mesh(n_data=8, n_model=1),
+        active_cap=2, pad_entities_to_multiple=8,
+    )
+    ent_g = np.asarray(ds.row_entity)
+    in_entity = np.flatnonzero(ent_g >= 0).astype(np.int64)
+    ar = np.asarray(ds.blocks.active_rows).ravel()
+    active = np.sort(ar[ar >= 0].astype(np.int64))
+    union = np.sort(np.concatenate([active, ds.passive_rows]))
+    np.testing.assert_array_equal(union, in_entity)
+    assert len(ds.passive_rows) == 3 * (7 - 2)

@@ -188,3 +188,89 @@ def test_cli_trains_streamed_re_with_parity(tmp_path):
         + ["--coordinate", re_coord + ",hbm.budget.mb=0", "--output-dir", out_str]
     )
     assert abs(s_str["best"]["metrics"]["AUC"] - s_mem["best"]["metrics"]["AUC"]) < 1e-3
+
+
+def test_solve_streamed_all_segments_empty():
+    """Regression: solve_streamed used to IndexError on ``results[0]`` when
+    every segment was empty; it must return an empty (all-padding)
+    SolverResult instead."""
+    from photon_ml_tpu.game.data import EntityBlocks
+    from photon_ml_tpu.game.streaming import solve_streamed
+    from photon_ml_tpu.optimize.common import ConvergenceReason
+
+    E, K, S = 4, 3, 2
+    blocks = EntityBlocks(
+        features=np.zeros((E, K, S), np.float32),
+        labels=np.zeros((E, K), np.float32),
+        offsets=np.zeros((E, K), np.float32),
+        weights=np.zeros((E, K), np.float32),
+        proj_cols=np.full((E, S), -1, np.int32),
+        active_rows=np.full((E, K), -1, np.int32),
+    )
+
+    def _never_called(*a, **kw):
+        raise AssertionError("train_fn must not run with no slices")
+
+    res = solve_streamed(
+        blocks_np=blocks,
+        segments=[],  # every bucket filtered out
+        residual_scores=None,
+        w0_np=np.zeros((E, S), np.float32),
+        prior_mean_np=np.zeros((E, S), np.float32),
+        prior_prec_np=np.zeros((E, S), np.float32),
+        budget_bytes=1 << 20,
+        train_fn=_never_called,
+        solver_kwargs={"max_iterations": 5},
+    )
+    assert res.coefficients.shape == (E, S)
+    np.testing.assert_array_equal(res.coefficients, 0.0)
+    np.testing.assert_array_equal(
+        res.reason, int(ConvergenceReason.NOT_CONVERGED)
+    )
+    np.testing.assert_array_equal(res.iterations, 0)
+    assert res.loss_history.shape == (E, 6)
+    assert np.isnan(res.loss_history).all() and np.isnan(res.grad_norm_history).all()
+
+
+def test_block_byte_estimates_respect_scalar_itemsize():
+    """Satellite fix: label/offset/weight itemsizes must come from the actual
+    dtype, not a hardcoded 4 — f64 scalars double the three [E, K] planes."""
+    from photon_ml_tpu.game.streaming import entities_per_slice, estimate_block_bytes
+
+    E, K, S = 2, 3, 4
+    f32 = estimate_block_bytes(E, K, S, feature_itemsize=4)
+    f64 = estimate_block_bytes(E, K, S, feature_itemsize=4, scalar_itemsize=8)
+    # labels + offsets + weights are the scalar planes: 3 * E * K extra bytes
+    # per extra itemsize byte
+    assert f64 == f32 + 3 * E * K * 4
+
+    budget = 1 << 16
+    wide = entities_per_slice(budget, K, S, feature_itemsize=4, scalar_itemsize=8)
+    narrow = entities_per_slice(budget, K, S, feature_itemsize=4)
+    assert 0 < wide <= narrow  # wider scalars -> fewer entities fit
+
+
+def test_solve_streamed_uses_label_dtype_for_budget(raw, monkeypatch):
+    """An f64 streamed dataset must budget with 8-byte scalars: the actual
+    staged max-slice bytes may not exceed the (corrected) estimate."""
+    monkeypatch.setenv("PHOTON_RE_SOLVER", "vmapped")
+    from photon_ml_tpu import obs
+
+    kw = dict(active_cap=64, dtype=jnp.float64)
+    streamed = build_random_effect_dataset(
+        raw, "re", "userShard", "userId", hbm_budget_bytes=64 << 10, **kw
+    )
+    assert streamed.streamed
+    assert np.dtype(streamed.blocks.labels.dtype).itemsize == 8
+    run = obs.RunTelemetry()
+    with obs.use_run(run):
+        c = RandomEffectCoordinate(
+            dataset=streamed, task="logistic_regression", config=_cfg()
+        )
+        c.train(None)
+        snap = {m["name"]: m for m in run.registry.snapshot()}
+    est = snap["photon_stream_estimated_slice_bytes"]["value"]
+    actual = snap["photon_stream_actual_slice_bytes"]["value"]
+    assert actual <= est
+    assert snap["photon_stream_slices_total"]["value"] >= 1
+    assert snap["photon_stream_staged_bytes_total"]["value"] >= actual
