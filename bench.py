@@ -77,16 +77,16 @@ def build_data(n=500_000, d_fixed=1024, n_users=20_000, d_re=32, seed=0):
     rng = np.random.default_rng(seed)
     gx = rng.standard_normal((n, d_fixed), dtype=np.float32)
     gx[:, -1] = 1.0
-    w = (rng.standard_normal(d_fixed) / np.sqrt(d_fixed)).astype(np.float32)
+    w = (rng.standard_normal(d_fixed) / np.sqrt(d_fixed)).astype(gx.dtype)
     z = gx @ w
     probs = 1.0 / np.arange(1, n_users + 1) ** 1.1
     probs /= probs.sum()
     assign = rng.choice(n_users, size=n, p=probs)
     ex = rng.standard_normal((n, d_re), dtype=np.float32)
     ex[:, -1] = 1.0
-    w_u = (rng.standard_normal((n_users, d_re)) / np.sqrt(d_re)).astype(np.float32)
+    w_u = (rng.standard_normal((n_users, d_re)) / np.sqrt(d_re)).astype(ex.dtype)
     z = z + np.einsum("nd,nd->n", ex, w_u[assign])
-    y = (rng.uniform(size=n) < 1.0 / (1.0 + np.exp(-z))).astype(np.float32)
+    y = (rng.uniform(size=n) < 1.0 / (1.0 + np.exp(-z))).astype(gx.dtype)
     ids = np.char.add("u", assign.astype(str)).astype(object)
     return gx, y, ex, ids
 
@@ -130,8 +130,10 @@ def _glmix_datasets(gx, y, ex, ids, feature_dtype=None):
 
 
 def bench_tpu(fe_ds, re_ds, reg=1.0, sweeps=1):
+    import jax
     import jax.numpy as jnp
 
+    from photon_ml_tpu.analysis import transfer_guard
     from photon_ml_tpu.game import (
         CoordinateDescent,
         FixedEffectCoordinate,
@@ -162,15 +164,23 @@ def bench_tpu(fe_ds, re_ds, reg=1.0, sweeps=1):
                 dataset=re_ds, task="logistic_regression", config=cfg_re
             ),
         }
-        result = CoordinateDescent(coords, n_iterations=sweeps).run()
-        # true sync via ONE scalar fetch depending on both models (a
-        # full-model fetch would bill the harness's slow host link to the
-        # sweep, and each separate scalar fetch costs a ~100ms+ tunnel round
-        # trip; real deployments read the model over PCIe once at save time)
-        float(
-            jnp.sum(result.model["per-user"].coef_values)
-            + jnp.sum(result.model["global"].model.coefficients.means)
-        )
+        # the whole bench run executes under the transfer guard: any implicit
+        # device->host fetch inside the sweep raises instead of silently
+        # billing a host round trip to the measured wall time
+        with transfer_guard():
+            result = CoordinateDescent(coords, n_iterations=sweeps).run()
+            # true sync via ONE scalar fetch depending on both models (a
+            # full-model fetch would bill the harness's slow host link to the
+            # sweep, and each separate scalar fetch costs a ~100ms+ tunnel
+            # round trip; real deployments read the model over PCIe once at
+            # save time). Explicit device_get: float() on a device array is
+            # exactly what the guard rejects.
+            float(
+                jax.device_get(
+                    jnp.sum(result.model["per-user"].coef_values)
+                    + jnp.sum(result.model["global"].model.coefficients.means)
+                )
+            )
         return result
 
     run()  # warmup/compile
@@ -233,9 +243,9 @@ def bench_cpu_baseline(gx, y, ex, ids, reg=1.0, entity_subsample=10):
 
     def logistic_vg(x, yv, lam):
         def f(w):
-            z = x @ w.astype(np.float32)
+            z = x @ w.astype(x.dtype)
             v = np.sum(np.log1p(np.exp(-np.abs(z))) + np.maximum(z, 0) - yv * z)
-            g = x.T @ (1.0 / (1.0 + np.exp(-z)) - yv).astype(np.float32)
+            g = x.T @ (1.0 / (1.0 + np.exp(-z)) - yv).astype(x.dtype)
             return float(v) + 0.5 * lam * w @ w, g.astype(np.float64) + lam * w
 
         return f
@@ -249,7 +259,7 @@ def bench_cpu_baseline(gx, y, ex, ids, reg=1.0, entity_subsample=10):
         method="L-BFGS-B",
         options=dict(maxiter=10),
     )
-    fixed_scores = gx @ r.x.astype(np.float32)
+    fixed_scores = gx @ r.x.astype(gx.dtype)
     t_fixed = time.perf_counter() - t0
 
     # random effects: per-entity solves on a subsample, extrapolated
@@ -430,13 +440,14 @@ def bench_billion_coef(n_slices=4, e_slice=32_768, k=16, s=256, total_coef=1_024
     from photon_ml_tpu.game.coordinate import _train_blocks_packed as _train_blocks
 
     rng = np.random.default_rng(0)
-    feats = (rng.normal(size=(e_slice, k, s)) * 0.3).astype(np.float32)
-    y = (rng.uniform(size=(e_slice, k)) < 0.5).astype(np.float32)
-    off = np.zeros((e_slice, k), np.float32)
-    wt = np.ones((e_slice, k), np.float32)
-    w0 = np.zeros((e_slice, s), np.float32)
-    zeros = np.zeros((e_slice, s), np.float32)
-    ones = np.ones((e_slice, s), np.float32)
+    dt = np.float32  # the packed solver's state dtype; one binding, one place
+    feats = (rng.normal(size=(e_slice, k, s)) * 0.3).astype(dt)
+    y = (rng.uniform(size=(e_slice, k)) < 0.5).astype(dt)
+    off = np.zeros((e_slice, k), dt)
+    wt = np.ones((e_slice, k), dt)
+    w0 = np.zeros((e_slice, s), dt)
+    zeros = np.zeros((e_slice, s), dt)
+    ones = np.ones((e_slice, s), dt)
     kw = dict(
         task="logistic_regression", l2=1.0, l1=0.0, optimizer_type="LBFGS",
         tolerance=1e-6, max_iterations=30, num_corrections=10,
@@ -445,8 +456,8 @@ def bench_billion_coef(n_slices=4, e_slice=32_768, k=16, s=256, total_coef=1_024
     common = [jnp.asarray(a) for a in (off, wt, w0, zeros, ones)]
     # two distinct host slices rotated through the double buffer (a real
     # pipeline would decode fresh data into the staging buffer each step)
-    feats2 = (rng.normal(size=(e_slice, k, s)) * 0.3).astype(np.float32)
-    y2 = (rng.uniform(size=(e_slice, k)) < 0.5).astype(np.float32)
+    feats2 = (rng.normal(size=(e_slice, k, s)) * 0.3).astype(dt)
+    y2 = (rng.uniform(size=(e_slice, k)) < 0.5).astype(dt)
     host_slices = [(feats, y), (feats2, y2)]
 
     def put(h):
@@ -674,9 +685,9 @@ def bench_hbm_attribution(n=500_000, d=1024, repeats=30):
 
     rng = np.random.default_rng(0)
     gx = rng.standard_normal((n, d), dtype=np.float32)
-    y = (rng.uniform(size=n) < 0.5).astype(np.float32)
+    y = (rng.uniform(size=n) < 0.5).astype(gx.dtype)
     batch = batch_from_dense(gx, y)
-    bytes_per_call = 2.0 * n * d * 4
+    bytes_per_call = 2.0 * n * d * gx.dtype.itemsize
 
     # Timing discipline for the remote tunnel: block_until_ready does NOT
     # synchronize through axon (dispatch pipelines one-deep and "block"

@@ -1,0 +1,53 @@
+"""JAX-aware static analysis for photon-ml-tpu.
+
+The package's two recurring defect classes — silent host<->device syncs and
+dtype-discipline bugs — are mechanical, not creative: a ``float()`` on a jax
+array in the coordinate-descent hot loop, a hardcoded ``* 4`` itemsize that
+under-counts an x64 dataset, an ``except Exception`` that eats a real error.
+The reference Photon ML leaned on scalac's type discipline for this class of
+invariant; a dynamically typed JAX port has to build its own. This package is
+that discipline, in two halves:
+
+- **static**: an AST linter (stdlib ``ast`` only) with four JAX-specific
+  rules — R1 implicit device transfer in hot-loop modules, R2 recompile
+  hazards inside ``@jit``, R3 dtype discipline (hardcoded itemsizes, dtype
+  literals), R4 swallow-and-continue exception handlers. Run it with
+  ``python -m photon_ml_tpu.analysis``; configure it from
+  ``[tool.photon-lint]`` in pyproject.toml; suppress individual lines with
+  ``# photon: ignore[RULE]``; grandfather findings in a checked-in baseline.
+
+- **runtime**: :func:`transfer_guard`, a context manager the CD sweep and
+  bench enter, which makes JAX hard-error on any *implicit* device->host
+  fetch. Legitimate fetches go through :func:`logged_fetch` (explicit
+  ``jax.device_get`` + an obs byte counter), so "zero unlogged fetches in
+  the hot loop" is enforced by the runtime, not just asserted by a test.
+"""
+
+from .config import LintConfig, find_repo_root, load_config
+from .engine import (
+    Finding,
+    LintResult,
+    analyze_paths,
+    analyze_source,
+    load_baseline,
+    write_baseline,
+)
+from .rules import RULES
+from .runtime import allow_transfers, guard_level, logged_fetch, transfer_guard
+
+__all__ = [
+    "Finding",
+    "LintConfig",
+    "LintResult",
+    "RULES",
+    "allow_transfers",
+    "analyze_paths",
+    "analyze_source",
+    "find_repo_root",
+    "guard_level",
+    "load_baseline",
+    "load_config",
+    "logged_fetch",
+    "transfer_guard",
+    "write_baseline",
+]

@@ -1,0 +1,122 @@
+"""``python -m photon_ml_tpu.analysis`` — the lint CLI.
+
+Exit codes: 0 clean, 1 active findings (or parse errors), 2 usage error.
+Human output is one ``path:line:col: RULE message`` block per finding;
+``--json`` emits a machine-readable report for CI annotation.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+from typing import List, Optional
+
+from .config import load_config
+from .engine import analyze_paths, load_baseline, write_baseline
+from .rules import RULES
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        prog="python -m photon_ml_tpu.analysis",
+        description="JAX-aware static analysis: transfer/recompile/dtype/"
+        "swallow lint (rules R1-R4) configured by [tool.photon-lint] "
+        "in pyproject.toml",
+    )
+    p.add_argument(
+        "paths",
+        nargs="*",
+        help="files or directories to lint (default: configured paths)",
+    )
+    p.add_argument("--config", help="pyproject.toml to read [tool.photon-lint] from")
+    p.add_argument("--baseline", help="override the configured baseline path")
+    p.add_argument(
+        "--no-baseline",
+        action="store_true",
+        help="report grandfathered findings too",
+    )
+    p.add_argument(
+        "--write-baseline",
+        action="store_true",
+        help="write current unsuppressed findings as the new baseline and exit 0",
+    )
+    p.add_argument(
+        "--rule",
+        action="append",
+        choices=sorted(RULES),
+        help="run only these rules (repeatable)",
+    )
+    p.add_argument("--json", action="store_true", help="JSON report on stdout")
+    p.add_argument(
+        "--list-rules", action="store_true", help="print the rule table and exit"
+    )
+    return p
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    args = build_parser().parse_args(argv)
+    if args.list_rules:
+        for rule, desc in sorted(RULES.items()):
+            print(f"{rule}  {desc}")
+        return 0
+    try:
+        config = load_config(pyproject=args.config)
+    except ValueError as e:
+        print(f"error: {e}", file=sys.stderr)
+        return 2
+
+    baseline_path = args.baseline or config.baseline_path
+    try:
+        baseline = None if args.no_baseline else load_baseline(baseline_path)
+    except (ValueError, json.JSONDecodeError) as e:
+        print(f"error: {e}", file=sys.stderr)
+        return 2
+
+    result = analyze_paths(
+        paths=args.paths or None,
+        config=config,
+        baseline=None if args.write_baseline else baseline,
+        rules=args.rule,
+    )
+
+    if args.write_baseline:
+        n = write_baseline(result.findings, baseline_path)
+        print(f"wrote {n} finding(s) to {baseline_path}")
+        return 0
+
+    if args.json:
+        print(
+            json.dumps(
+                {
+                    "files_scanned": result.files_scanned,
+                    "parse_errors": result.parse_errors,
+                    "findings": [f.to_dict() for f in result.findings],
+                    "active": len(result.active),
+                    "ok": result.ok,
+                },
+                indent=2,
+            )
+        )
+    else:
+        for f in result.findings:
+            if f.suppressed:
+                continue
+            tag = " [baselined]" if f.baselined else ""
+            print(f"{f.file}:{f.line}:{f.col}: {f.rule}{tag} {f.message}")
+            if f.code:
+                print(f"    {f.code}")
+        for err in result.parse_errors:
+            print(f"parse error: {err}", file=sys.stderr)
+        n_sup = sum(1 for f in result.findings if f.suppressed)
+        n_base = sum(1 for f in result.findings if f.baselined)
+        print(
+            f"{len(result.active)} active finding(s) "
+            f"({n_sup} suppressed, {n_base} baselined) "
+            f"in {result.files_scanned} file(s)"
+        )
+    return 0 if result.ok else 1
+
+
+if __name__ == "__main__":  # pragma: no cover
+    sys.exit(main())

@@ -1,0 +1,184 @@
+"""Lint configuration, loaded from ``[tool.photon-lint]`` in pyproject.toml.
+
+One configuration site feeds every consumer — the ``python -m
+photon_ml_tpu.analysis`` CLI, the tier-1 self-check test, and any editor
+integration — so the hot-loop module list and the baseline path cannot drift
+between them.
+
+Python 3.10 has no ``tomllib``; rather than grow a dependency, the loader
+falls back to a deliberately small TOML-subset reader that understands
+exactly what this config needs: ``[section]`` headers, string / int / bool
+values, and (possibly multi-line) arrays of strings. Anything fancier lives
+in sections the reader skips.
+"""
+
+from __future__ import annotations
+
+import ast as _pyast
+import dataclasses
+import fnmatch
+import os
+import re
+from typing import Dict, Optional, Sequence, Tuple
+
+SECTION = "tool.photon-lint"
+
+# Defaults mirror the checked-in pyproject.toml so the analyzer still works
+# when invoked on a bare tree (e.g. a vendored copy without the config file).
+DEFAULT_HOT_LOOP_MODULES: Tuple[str, ...] = (
+    "photon_ml_tpu/game/descent.py",
+    "photon_ml_tpu/game/coordinate.py",
+    "photon_ml_tpu/game/streaming.py",
+    "photon_ml_tpu/optimize/*",
+)
+DEFAULT_DTYPE_STRICT_MODULES: Tuple[str, ...] = ("photon_ml_tpu/ops/*",)
+
+
+def _match(relpath: str, patterns: Sequence[str]) -> bool:
+    """fnmatch against posix relpaths; a pattern naming a directory (no glob
+    meta, no .py suffix) matches everything under it."""
+    for pat in patterns:
+        if fnmatch.fnmatch(relpath, pat):
+            return True
+        if not any(c in pat for c in "*?[") and not pat.endswith(".py"):
+            if relpath == pat or relpath.startswith(pat.rstrip("/") + "/"):
+                return True
+    return False
+
+
+@dataclasses.dataclass(frozen=True)
+class LintConfig:
+    paths: Tuple[str, ...] = ("photon_ml_tpu",)
+    baseline: str = "lint_baseline.json"
+    exclude: Tuple[str, ...] = ()
+    hot_loop_modules: Tuple[str, ...] = DEFAULT_HOT_LOOP_MODULES
+    dtype_strict_modules: Tuple[str, ...] = DEFAULT_DTYPE_STRICT_MODULES
+    root: str = "."
+
+    def is_hot(self, relpath: str) -> bool:
+        return _match(relpath, self.hot_loop_modules)
+
+    def is_dtype_strict(self, relpath: str) -> bool:
+        return _match(relpath, self.dtype_strict_modules)
+
+    def is_excluded(self, relpath: str) -> bool:
+        return _match(relpath, self.exclude)
+
+    @property
+    def baseline_path(self) -> str:
+        return os.path.join(self.root, self.baseline)
+
+
+def find_repo_root(start: Optional[str] = None) -> str:
+    """Nearest ancestor of ``start`` (default: cwd) holding a pyproject.toml,
+    else ``start`` itself."""
+    d = os.path.abspath(start or os.getcwd())
+    while True:
+        if os.path.isfile(os.path.join(d, "pyproject.toml")):
+            return d
+        parent = os.path.dirname(d)
+        if parent == d:
+            return os.path.abspath(start or os.getcwd())
+        d = parent
+
+
+_KEY_RE = re.compile(r"^([A-Za-z0-9_-]+)\s*=\s*(.*)$")
+
+
+def _strip_comment(line: str) -> str:
+    """Drop a trailing ``#`` comment that is not inside a string literal."""
+    out = []
+    in_str = None
+    for ch in line:
+        if in_str:
+            if ch == in_str:
+                in_str = None
+        elif ch in ("'", '"'):
+            in_str = ch
+        elif ch == "#":
+            break
+        out.append(ch)
+    return "".join(out)
+
+
+def _parse_value(text: str):
+    text = text.strip()
+    if text in ("true", "false"):
+        return text == "true"
+    # strings / ints / arrays of these are valid Python literals as written
+    return _pyast.literal_eval(text)
+
+
+def _read_section(path: str, section: str) -> Dict[str, object]:
+    """Subset-TOML: values of ``[section]`` only; other sections skipped."""
+    try:
+        import tomllib  # Python 3.11+
+
+        with open(path, "rb") as f:
+            data = tomllib.load(f)
+        node: object = data
+        for part in section.split("."):
+            if not isinstance(node, dict) or part not in node:
+                return {}
+            node = node[part]
+        return dict(node) if isinstance(node, dict) else {}
+    except ImportError:
+        pass
+    out: Dict[str, object] = {}
+    current = None
+    pending_key = None
+    pending_text = ""
+    with open(path, encoding="utf-8") as f:
+        for raw in f:
+            line = _strip_comment(raw).strip()
+            if not line:
+                continue
+            if pending_key is not None:
+                pending_text += " " + line
+                if pending_text.count("[") == pending_text.count("]"):
+                    out[pending_key] = _parse_value(pending_text)
+                    pending_key, pending_text = None, ""
+                continue
+            if line.startswith("["):
+                current = line.strip("[]").strip().strip('"')
+                continue
+            if current != section:
+                continue
+            m = _KEY_RE.match(line)
+            if not m:
+                raise ValueError(f"{path}: cannot parse line {raw!r}")
+            key, value = m.group(1), m.group(2).strip()
+            if value.count("[") != value.count("]"):
+                pending_key, pending_text = key, value
+            else:
+                out[key] = _parse_value(value)
+    if pending_key is not None:
+        raise ValueError(f"{path}: unterminated array for key {pending_key!r}")
+    return out
+
+
+def load_config(
+    pyproject: Optional[str] = None, root: Optional[str] = None
+) -> LintConfig:
+    """LintConfig from ``[tool.photon-lint]``; defaults when absent."""
+    if pyproject is None:
+        root = find_repo_root(root)
+        pyproject = os.path.join(root, "pyproject.toml")
+    elif root is None:
+        root = os.path.dirname(os.path.abspath(pyproject)) or "."
+    values: Dict[str, object] = {}
+    if os.path.isfile(pyproject):
+        values = _read_section(pyproject, SECTION)
+    known = {f.name for f in dataclasses.fields(LintConfig)} - {"root"}
+    unknown = set(values) - {k.replace("-", "_") for k in known} - known
+    if unknown:
+        raise ValueError(
+            f"[{SECTION}] has unknown keys {sorted(unknown)}; expected "
+            f"{sorted(known)}"
+        )
+    kwargs = {}
+    for field in known:
+        if field in values:
+            v = values[field]
+            kwargs[field] = tuple(v) if isinstance(v, list) else v
+    return LintConfig(root=root, **kwargs)

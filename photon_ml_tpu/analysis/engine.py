@@ -1,0 +1,248 @@
+"""Lint engine: file walking, suppression comments, baseline bookkeeping.
+
+Suppression is per line and per rule::
+
+    x = float(score_sum)  # photon: ignore[R1] — logged two lines up
+
+A comment that has a line to itself suppresses the next code line instead
+(for justifications too long to share the line)::
+
+    # photon: ignore[R4] — future semantics: stored, re-raised in result()
+    except BaseException as e:
+
+Multiple rules separate with commas (``# photon: ignore[R1,R3]``). There is
+deliberately no blanket ignore-all spelling: every suppression names the
+rule it silences, so a future rule cannot be pre-silenced by accident.
+
+The baseline file grandfathers findings that predate the linter (or that a
+rule change newly surfaces) without blocking CI. Entries match on
+``(file, rule, stripped source line)`` — robust against unrelated edits
+moving lines — and matching is multiset-aware: three identical offending
+lines need three baseline entries. Regenerate with ``--write-baseline``;
+shrink it over time by fixing what it lists.
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+import io
+import json
+import os
+import re
+import tokenize
+from collections import Counter
+from typing import Dict, List, Optional, Sequence, Set, Tuple
+
+from .config import LintConfig
+from .rules import RULES, run_rules
+
+_SUPPRESS_RE = re.compile(r"#\s*photon:\s*ignore\[([A-Za-z0-9_,\s]+)\]")
+
+BASELINE_VERSION = 1
+
+
+@dataclasses.dataclass(frozen=True)
+class Finding:
+    file: str  # posix relpath from the config root
+    line: int
+    col: int
+    rule: str
+    message: str
+    code: str  # stripped source line
+    suppressed: bool = False
+    baselined: bool = False
+
+    @property
+    def active(self) -> bool:
+        """Counts against the exit code."""
+        return not (self.suppressed or self.baselined)
+
+    def key(self) -> Tuple[str, str, str]:
+        return (self.file, self.rule, self.code)
+
+    def to_dict(self) -> Dict[str, object]:
+        return dataclasses.asdict(self)
+
+
+@dataclasses.dataclass
+class LintResult:
+    findings: List[Finding]
+    files_scanned: int
+    parse_errors: List[str] = dataclasses.field(default_factory=list)
+
+    @property
+    def active(self) -> List[Finding]:
+        return [f for f in self.findings if f.active]
+
+    @property
+    def ok(self) -> bool:
+        return not self.active and not self.parse_errors
+
+
+def _suppressions(source: str) -> Dict[int, Set[str]]:
+    """line -> suppressed rules, from real COMMENT tokens only (a docstring
+    that *mentions* the ignore syntax must not suppress anything). Inline
+    comments suppress their own line; a comment owning the whole line
+    suppresses the next code line (skipping blanks and further comments)."""
+    out: Dict[int, Set[str]] = {}
+    lines = source.splitlines()
+    for tok in tokenize.generate_tokens(io.StringIO(source).readline):
+        if tok.type != tokenize.COMMENT:
+            continue
+        m = _SUPPRESS_RE.search(tok.string)
+        if not m:
+            continue
+        lineno = tok.start[0]
+        rules = {r.strip() for r in m.group(1).split(",") if r.strip()}
+        bad = rules - set(RULES)
+        if bad:
+            raise ValueError(
+                f"line {lineno}: photon: ignore names unknown rule(s) "
+                f"{sorted(bad)}; known: {sorted(RULES)}"
+            )
+        if tok.line.strip().startswith("#"):
+            # standalone comment: applies to the next code line
+            target = lineno + 1
+            while target <= len(lines) and (
+                not lines[target - 1].strip()
+                or lines[target - 1].lstrip().startswith("#")
+            ):
+                target += 1
+            out.setdefault(target, set()).update(rules)
+        else:
+            out.setdefault(lineno, set()).update(rules)
+    return out
+
+
+def analyze_source(
+    source: str,
+    relpath: str,
+    config: Optional[LintConfig] = None,
+    rules: Optional[Sequence[str]] = None,
+) -> List[Finding]:
+    """Lint one module's source. ``relpath`` decides which module-scoped
+    rules apply (hot-loop R1, dtype-strict R3 subrule)."""
+    config = config or LintConfig()
+    tree = ast.parse(source, filename=relpath)
+    raw = run_rules(
+        tree,
+        hot=config.is_hot(relpath),
+        dtype_strict=config.is_dtype_strict(relpath),
+        rules=rules,
+    )
+    sup = _suppressions(source)
+    lines = source.splitlines()
+    findings = []
+    for rf in raw:
+        code = lines[rf.line - 1].strip() if 0 < rf.line <= len(lines) else ""
+        findings.append(
+            Finding(
+                file=relpath,
+                line=rf.line,
+                col=rf.col,
+                rule=rf.rule,
+                message=rf.message,
+                code=code,
+                suppressed=rf.rule in sup.get(rf.line, ()),
+            )
+        )
+    return findings
+
+
+def iter_python_files(paths: Sequence[str], config: LintConfig) -> List[str]:
+    """Absolute paths of the .py files to lint, config excludes applied."""
+    out: List[str] = []
+    for p in paths:
+        p = p if os.path.isabs(p) else os.path.join(config.root, p)
+        if os.path.isfile(p):
+            out.append(os.path.abspath(p))
+            continue
+        for dirpath, dirnames, filenames in os.walk(p):
+            dirnames[:] = sorted(
+                d for d in dirnames if d not in ("__pycache__", ".git")
+            )
+            for name in sorted(filenames):
+                if name.endswith(".py"):
+                    out.append(os.path.abspath(os.path.join(dirpath, name)))
+    root = os.path.abspath(config.root)
+    filtered = []
+    for path in out:
+        rel = os.path.relpath(path, root).replace(os.sep, "/")
+        if not config.is_excluded(rel):
+            filtered.append(path)
+    return filtered
+
+
+def analyze_paths(
+    paths: Optional[Sequence[str]] = None,
+    config: Optional[LintConfig] = None,
+    baseline: Optional[Counter] = None,
+    rules: Optional[Sequence[str]] = None,
+) -> LintResult:
+    """Lint files/directories; default paths come from the config."""
+    config = config or LintConfig()
+    files = iter_python_files(paths or config.paths, config)
+    root = os.path.abspath(config.root)
+    findings: List[Finding] = []
+    errors: List[str] = []
+    for path in files:
+        rel = os.path.relpath(path, root).replace(os.sep, "/")
+        try:
+            with open(path, encoding="utf-8") as f:
+                source = f.read()
+            findings.extend(analyze_source(source, rel, config, rules=rules))
+        except (SyntaxError, ValueError) as e:
+            errors.append(f"{rel}: {e}")
+    if baseline:
+        findings = apply_baseline(findings, baseline)
+    return LintResult(
+        findings=findings, files_scanned=len(files), parse_errors=errors
+    )
+
+
+# --------------------------------------------------------------------------
+# baseline
+
+
+def load_baseline(path: str) -> Counter:
+    """(file, rule, code) multiset from a baseline JSON file; empty when the
+    file does not exist (a missing baseline means nothing is grandfathered)."""
+    if not os.path.isfile(path):
+        return Counter()
+    with open(path, encoding="utf-8") as f:
+        data = json.load(f)
+    if data.get("version") != BASELINE_VERSION:
+        raise ValueError(
+            f"{path}: baseline version {data.get('version')!r} != "
+            f"{BASELINE_VERSION}; regenerate with --write-baseline"
+        )
+    return Counter(
+        (e["file"], e["rule"], e["code"]) for e in data.get("findings", [])
+    )
+
+
+def apply_baseline(findings: List[Finding], baseline: Counter) -> List[Finding]:
+    remaining = Counter(baseline)
+    out = []
+    for f in findings:
+        if not f.suppressed and remaining.get(f.key(), 0) > 0:
+            remaining[f.key()] -= 1
+            f = dataclasses.replace(f, baselined=True)
+        out.append(f)
+    return out
+
+
+def write_baseline(findings: Sequence[Finding], path: str) -> int:
+    """Write all unsuppressed findings as the new baseline; returns count."""
+    entries = [
+        {"file": f.file, "rule": f.rule, "code": f.code}
+        for f in sorted(findings, key=lambda f: (f.file, f.line, f.col))
+        if not f.suppressed
+    ]
+    with open(path, "w", encoding="utf-8") as f:
+        json.dump(
+            {"version": BASELINE_VERSION, "findings": entries}, f, indent=2
+        )
+        f.write("\n")
+    return len(entries)

@@ -1,0 +1,737 @@
+"""JAX-aware lint rules (stdlib ``ast`` only).
+
+R1 implicit-device-transfer: ``float()`` / ``int()`` / ``bool()`` /
+   ``np.asarray()`` / ``np.array()`` applied to a jax-typed value, or any
+   ``.item()`` call, inside the configured hot-loop modules. Each of these
+   blocks the Python thread on a device->host round trip — measured at
+   ~100 ms+ through a remote-accelerator link — and none of them announce
+   themselves. The fix is to keep the value on device, or to fetch
+   explicitly through ``analysis.runtime.logged_fetch`` (counted by obs and
+   permitted by the runtime transfer guard).
+
+R2 recompile-hazard: inside a ``@jax.jit`` function, a Python ``if`` /
+   ``while`` on a tracer-typed name (a ConcretizationTypeError at best, a
+   silent per-value recompile with hashable scalars at worst), an f-string
+   formatting a tracer, and malformed ``static_argnums`` / ``static_argnames``
+   (non-literal values, names that match no parameter, or parameters
+   annotated as arrays — array-valued statics recompile on every distinct
+   value).
+
+R3 dtype-discipline: hardcoded ``4`` / ``8`` itemsize multipliers in
+   byte-accounting code (the PR-1 HBM-budget bug class: an x64 dataset
+   under-counted by 2x), ``np.float32(...)`` casts and
+   ``.astype(np.float32)`` where the dtype should be derived from the data,
+   and — in the configured dtype-strict modules — ``jnp.array(...)`` /
+   ``jnp.asarray(...)`` without an explicit dtype (silently picks f32 or
+   weak-types by backend default).
+
+R4 swallow-and-continue: ``except Exception`` (or bare ``except``) whose
+   handler neither re-raises at its top level nor increments an obs counter
+   — errors that vanish without a trace in metrics.jsonl. Narrow the
+   exception type, re-raise, or call ``obs.swallowed_error(site)``.
+
+Taint tracking is deliberately local and conservative: names become
+"jax-typed" through parameter annotations (``Array``, ``jax.Array``, ...)
+and through assignment from expressions rooted at ``jnp.`` / ``jax.`` calls
+or other tainted names; host-valued attributes (``.shape``, ``.dtype``) and
+host-valued jax calls (``jnp.shape``, ``jax.device_get``) stop propagation.
+False negatives are accepted (the runtime transfer guard backstops them);
+false positives should be rare enough to suppress by hand.
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+import re
+from typing import Callable, Dict, List, Optional, Sequence, Set, Tuple
+
+RULES: Dict[str, str] = {
+    "R1": "implicit device transfer in a hot-loop module",
+    "R2": "recompile hazard inside a @jit function",
+    "R3": "dtype discipline (hardcoded itemsize / dtype literal)",
+    "R4": "swallowed exception (no re-raise, no obs counter)",
+}
+
+# attributes whose value is host metadata, not an array: reading them off a
+# jax array neither transfers nor yields an array
+_HOST_ATTRS = {
+    "shape",
+    "dtype",
+    "ndim",
+    "size",
+    "nbytes",
+    "itemsize",
+    "sharding",
+    "device",
+    "devices",
+    "aval",
+    "weak_type",
+    "coordinate_id",
+    "name",
+}
+
+# jax-rooted callables that return host values (not arrays)
+_HOST_VALUED_CALLS = {
+    "jax.numpy.shape",
+    "jax.numpy.ndim",
+    "jax.numpy.size",
+    "jax.numpy.dtype",
+    "jax.numpy.promote_types",
+    "jax.numpy.result_type",
+    "jax.numpy.issubdtype",
+    "jax.device_get",
+    "jax.device_count",
+    "jax.local_device_count",
+    "jax.process_count",
+    "jax.process_index",
+    "jax.default_backend",
+    "jax.devices",
+    "jax.local_devices",
+    "jax.eval_shape",
+    "jax.tree_util.tree_structure",
+}
+
+# methods on arrays that return host scalars/objects ('.item()' is flagged
+# separately by R1; 'tolist' likewise transfers but appears in cold paths)
+_HOST_VALUED_METHODS = {"item", "tolist", "block_until_ready"}
+
+_ARRAY_ANNOTATIONS = {
+    "Array",
+    "ArrayLike",
+    "jax.Array",
+    "jnp.ndarray",
+    "jax.numpy.ndarray",
+    "chex.Array",
+}
+
+_ITEMSIZE_CONTEXT_RE = re.compile(
+    r"bytes|itemsize|budget|hbm|frombuffer|memmap", re.IGNORECASE
+)
+
+
+@dataclasses.dataclass(frozen=True)
+class RawFinding:
+    line: int
+    col: int
+    rule: str
+    message: str
+
+
+AddFn = Callable[[int, int, str, str], None]
+
+
+# --------------------------------------------------------------------------
+# shared helpers
+
+
+def _dotted(node: ast.AST) -> Optional[str]:
+    if isinstance(node, ast.Name):
+        return node.id
+    if isinstance(node, ast.Attribute):
+        base = _dotted(node.value)
+        return f"{base}.{node.attr}" if base else None
+    return None
+
+
+def _import_aliases(tree: ast.Module) -> Dict[str, str]:
+    """local name -> canonical dotted module ('jnp' -> 'jax.numpy')."""
+    aliases: Dict[str, str] = {}
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Import):
+            for a in node.names:
+                aliases[a.asname or a.name.split(".")[0]] = (
+                    a.name if a.asname else a.name.split(".")[0]
+                )
+        elif isinstance(node, ast.ImportFrom) and node.module and node.level == 0:
+            for a in node.names:
+                aliases[a.asname or a.name] = f"{node.module}.{a.name}"
+    return aliases
+
+
+def _canon(dotted: Optional[str], aliases: Dict[str, str]) -> Optional[str]:
+    if not dotted:
+        return None
+    head, _, rest = dotted.partition(".")
+    head = aliases.get(head, head)
+    return f"{head}.{rest}" if rest else head
+
+
+def _is_jax_rooted(canonical: Optional[str]) -> bool:
+    return bool(canonical) and (
+        canonical == "jax" or canonical.startswith(("jax.", "jax_"))
+    )
+
+
+def _annotation_is_array(ann: Optional[ast.AST]) -> bool:
+    if ann is None:
+        return False
+    for node in ast.walk(ann):
+        d = _dotted(node)
+        if d in _ARRAY_ANNOTATIONS:
+            return True
+        if isinstance(node, ast.Constant) and isinstance(node.value, str):
+            if node.value in _ARRAY_ANNOTATIONS:
+                return True
+    return False
+
+
+def _param_names(fn) -> List[str]:
+    a = fn.args
+    return [p.arg for p in (*a.posonlyargs, *a.args, *a.kwonlyargs)] + [
+        p.arg for p in (a.vararg, a.kwarg) if p is not None
+    ]
+
+
+def _expr_is_jaxy(node: ast.AST, tainted: Set[str], aliases: Dict[str, str]) -> bool:
+    """Conservative 'this expression evaluates to a jax array'."""
+    if isinstance(node, ast.Name):
+        return node.id in tainted
+    if isinstance(node, ast.Attribute):
+        if node.attr in _HOST_ATTRS:
+            return False
+        d = _canon(_dotted(node), aliases)
+        if d and _is_jax_rooted(d):
+            # bare jnp.float32 / jax.Array etc.: dtype/class objects
+            return False
+        return _expr_is_jaxy(node.value, tainted, aliases)
+    if isinstance(node, ast.Call):
+        d = _canon(_dotted(node.func), aliases)
+        if d:
+            if d in _HOST_VALUED_CALLS:
+                return False
+            if _is_jax_rooted(d):
+                return True
+        if isinstance(node.func, ast.Attribute):
+            if node.func.attr in _HOST_VALUED_METHODS:
+                return False
+            # method call on a jaxy receiver: x.astype(...), x.sum(), ...
+            return _expr_is_jaxy(node.func.value, tainted, aliases)
+        return False
+    if isinstance(node, ast.BinOp):
+        return _expr_is_jaxy(node.left, tainted, aliases) or _expr_is_jaxy(
+            node.right, tainted, aliases
+        )
+    if isinstance(node, ast.UnaryOp):
+        return _expr_is_jaxy(node.operand, tainted, aliases)
+    if isinstance(node, ast.Compare):
+        return _expr_is_jaxy(node.left, tainted, aliases) or any(
+            _expr_is_jaxy(c, tainted, aliases) for c in node.comparators
+        )
+    if isinstance(node, ast.Subscript):
+        return _expr_is_jaxy(node.value, tainted, aliases)
+    if isinstance(node, ast.IfExp):
+        return _expr_is_jaxy(node.body, tainted, aliases) or _expr_is_jaxy(
+            node.orelse, tainted, aliases
+        )
+    if isinstance(node, (ast.Tuple, ast.List)):
+        return any(_expr_is_jaxy(e, tainted, aliases) for e in node.elts)
+    return False
+
+
+def _own_nodes(fn) -> List[ast.AST]:
+    """All nodes of a function body EXCLUDING nested function/class bodies
+    (those are analyzed in their own scope)."""
+    out: List[ast.AST] = []
+    stack: List[ast.AST] = list(fn.body)
+    while stack:
+        node = stack.pop()
+        out.append(node)
+        if isinstance(
+            node, (ast.FunctionDef, ast.AsyncFunctionDef, ast.ClassDef, ast.Lambda)
+        ):
+            continue
+        stack.extend(ast.iter_child_nodes(node))
+    return out
+
+
+def _propagate_taint(
+    fn, seed: Set[str], aliases: Dict[str, str], rounds: int = 3
+) -> Set[str]:
+    """Fixpoint (bounded) over single-name assignments in the function's own
+    scope: a name assigned a jaxy expression becomes jaxy."""
+    tainted = set(seed)
+    nodes = _own_nodes(fn)
+    for _ in range(rounds):
+        before = len(tainted)
+        for node in nodes:
+            targets: List[ast.AST] = []
+            value: Optional[ast.AST] = None
+            if isinstance(node, ast.Assign):
+                targets, value = node.targets, node.value
+            elif isinstance(node, ast.AnnAssign) and node.value is not None:
+                targets, value = [node.target], node.value
+            elif isinstance(node, ast.AugAssign):
+                targets, value = [node.target], node.value
+            if value is None or not _expr_is_jaxy(value, tainted, aliases):
+                continue
+            for t in targets:
+                if isinstance(t, ast.Name):
+                    tainted.add(t.id)
+        if len(tainted) == before:
+            break
+    return tainted
+
+
+class _Module:
+    """Parsed module + shared lookups for the rule passes."""
+
+    def __init__(self, tree: ast.Module):
+        self.tree = tree
+        self.aliases = _import_aliases(tree)
+        self.functions: Dict[str, ast.FunctionDef] = {}
+        for node in ast.walk(tree):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                self.functions.setdefault(node.name, node)
+
+    def walk_functions(self):
+        for node in ast.walk(self.tree):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                yield node
+
+
+# --------------------------------------------------------------------------
+# R1: implicit device transfer in hot-loop modules
+
+
+def _run_r1(mod: _Module, add: AddFn) -> None:
+    aliases = mod.aliases
+    for fn in mod.walk_functions():
+        seed = {
+            p.arg
+            for p in (*fn.args.posonlyargs, *fn.args.args, *fn.args.kwonlyargs)
+            if _annotation_is_array(p.annotation)
+        }
+        tainted = _propagate_taint(fn, seed, aliases)
+        for node in _own_nodes(fn):
+            if not isinstance(node, ast.Call):
+                continue
+            d = _canon(_dotted(node.func), aliases)
+            if (
+                isinstance(node.func, ast.Attribute)
+                and node.func.attr == "item"
+                and not node.args
+                and not node.keywords
+            ):
+                add(
+                    node.lineno,
+                    node.col_offset,
+                    "R1",
+                    ".item() forces a device->host sync; fetch explicitly "
+                    "via analysis.runtime.logged_fetch or keep on device",
+                )
+                continue
+            if not node.args:
+                continue
+            first = node.args[0]
+            if d in ("float", "int", "bool") and len(node.args) == 1:
+                if _expr_is_jaxy(first, tainted, aliases):
+                    add(
+                        node.lineno,
+                        node.col_offset,
+                        "R1",
+                        f"{d}() on a jax value blocks on an implicit "
+                        "device->host transfer; use "
+                        "analysis.runtime.logged_fetch or keep on device",
+                    )
+            elif d in ("numpy.asarray", "numpy.array"):
+                if _expr_is_jaxy(first, tainted, aliases):
+                    add(
+                        node.lineno,
+                        node.col_offset,
+                        "R1",
+                        f"{d.replace('numpy', 'np')}() on a jax value is an "
+                        "implicit device->host fetch; use jax.device_get via "
+                        "analysis.runtime.logged_fetch so the transfer is "
+                        "explicit and counted",
+                    )
+
+
+# --------------------------------------------------------------------------
+# R2: recompile hazards
+
+
+def _static_names_from_jit(
+    call: Optional[ast.Call], fn, add: AddFn
+) -> Set[str]:
+    """Static parameter names from a jit(...) call's static_argnums /
+    static_argnames; reports malformed specs."""
+    statics: Set[str] = set()
+    if call is None:
+        return statics
+    params = _param_names(fn)
+    for kw in call.keywords:
+        if kw.arg == "static_argnames":
+            names: List[str] = []
+            ok = True
+            if isinstance(kw.value, ast.Constant) and isinstance(kw.value.value, str):
+                names = [kw.value.value]
+            elif isinstance(kw.value, (ast.Tuple, ast.List)):
+                for e in kw.value.elts:
+                    if isinstance(e, ast.Constant) and isinstance(e.value, str):
+                        names.append(e.value)
+                    else:
+                        ok = False
+            else:
+                ok = False
+            if not ok:
+                add(
+                    kw.value.lineno,
+                    kw.value.col_offset,
+                    "R2",
+                    "static_argnames must be a literal str/tuple of strs "
+                    "(non-literal statics hide recompile keys)",
+                )
+            for n in names:
+                if n not in params:
+                    add(
+                        kw.value.lineno,
+                        kw.value.col_offset,
+                        "R2",
+                        f"static_argnames entry {n!r} matches no parameter "
+                        f"of {fn.name}()",
+                    )
+                statics.add(n)
+        elif kw.arg == "static_argnums":
+            nums: List[int] = []
+            ok = True
+            if isinstance(kw.value, ast.Constant) and isinstance(kw.value.value, int):
+                nums = [kw.value.value]
+            elif isinstance(kw.value, (ast.Tuple, ast.List)):
+                for e in kw.value.elts:
+                    if isinstance(e, ast.Constant) and isinstance(e.value, int):
+                        nums.append(e.value)
+                    else:
+                        ok = False
+            else:
+                ok = False
+            if not ok:
+                add(
+                    kw.value.lineno,
+                    kw.value.col_offset,
+                    "R2",
+                    "static_argnums must be a literal int/tuple of ints",
+                )
+            pos = [p.arg for p in (*fn.args.posonlyargs, *fn.args.args)]
+            for i in nums:
+                if 0 <= i < len(pos):
+                    statics.add(pos[i])
+                else:
+                    add(
+                        kw.value.lineno,
+                        kw.value.col_offset,
+                        "R2",
+                        f"static_argnums entry {i} is out of range for "
+                        f"{fn.name}()",
+                    )
+    # array-annotated statics: hashability aside, every distinct value is a
+    # fresh compile cache key
+    by_name = {
+        p.arg: p
+        for p in (*fn.args.posonlyargs, *fn.args.args, *fn.args.kwonlyargs)
+    }
+    for name in sorted(statics):
+        p = by_name.get(name)
+        if p is not None and _annotation_is_array(p.annotation):
+            add(
+                p.lineno,
+                p.col_offset,
+                "R2",
+                f"parameter {name!r} is annotated as an array but marked "
+                "static: arrays are unhashable (TypeError) and, as statics, "
+                "would recompile per value",
+            )
+    return statics
+
+
+def _jit_call_of_decorator(dec: ast.AST, aliases: Dict[str, str]):
+    """(is_jit, jit_call_node_or_None) for one decorator expression."""
+    d = _canon(_dotted(dec), aliases)
+    if d in ("jax.jit", "jit"):
+        return True, None  # bare @jax.jit
+    if isinstance(dec, ast.Call):
+        dc = _canon(_dotted(dec.func), aliases)
+        if dc in ("jax.jit", "jit"):
+            return True, dec  # @jax.jit(static_argnames=...)
+        if dc in ("functools.partial", "partial") and dec.args:
+            inner = _canon(_dotted(dec.args[0]), aliases)
+            if inner in ("jax.jit", "jit"):
+                return True, dec  # @partial(jax.jit, static_argnames=...)
+    return False, None
+
+
+def _names_in_branchable(test: ast.AST, aliases: Dict[str, str]) -> Set[str]:
+    """Names referenced by a test expression, excluding host-valued contexts:
+    ``x is None`` checks, ``.shape``-like attributes, len()/isinstance()/
+    hasattr()/getattr() arguments, and host-valued jax calls."""
+    names: Set[str] = set()
+    skip_roots = (ast.Lambda,)
+
+    def visit(node: ast.AST) -> None:
+        if isinstance(node, skip_roots):
+            return
+        if isinstance(node, ast.Compare) and all(
+            isinstance(op, (ast.Is, ast.IsNot)) for op in node.ops
+        ):
+            return
+        if isinstance(node, ast.Attribute):
+            if node.attr in _HOST_ATTRS:
+                return
+            visit(node.value)
+            return
+        if isinstance(node, ast.Call):
+            d = _canon(_dotted(node.func), aliases)
+            if d in ("len", "isinstance", "hasattr", "getattr", "type") or (
+                d in _HOST_VALUED_CALLS
+            ):
+                return
+            if isinstance(node.func, ast.Attribute):
+                if node.func.attr in _HOST_VALUED_METHODS:
+                    return
+                visit(node.func.value)
+            for a in node.args:
+                visit(a)
+            for kw in node.keywords:
+                visit(kw.value)
+            return
+        if isinstance(node, ast.Name):
+            names.add(node.id)
+            return
+        for child in ast.iter_child_nodes(node):
+            visit(child)
+
+    visit(test)
+    return names
+
+
+def _check_jit_body(fn, statics: Set[str], aliases: Dict[str, str], add: AddFn):
+    tracers = set(_param_names(fn)) - statics - {"self", "cls"}
+    tainted = _propagate_taint(fn, tracers, aliases)
+    for node in _own_nodes(fn):
+        if isinstance(node, (ast.If, ast.While)):
+            hit = _names_in_branchable(node.test, aliases) & tainted
+            if hit:
+                kind = "if" if isinstance(node, ast.If) else "while"
+                add(
+                    node.lineno,
+                    node.col_offset,
+                    "R2",
+                    f"Python `{kind}` on tracer-typed value(s) "
+                    f"{sorted(hit)} inside @jit {fn.name}(): traced branches "
+                    "need jnp.where/lax.cond; a hashable value here means a "
+                    "recompile per distinct value",
+                )
+        elif isinstance(node, ast.JoinedStr):
+            hit: Set[str] = set()
+            for v in node.values:
+                if isinstance(v, ast.FormattedValue):
+                    hit |= _names_in_branchable(v.value, aliases) & tainted
+            if hit:
+                add(
+                    node.lineno,
+                    node.col_offset,
+                    "R2",
+                    f"f-string formats tracer value(s) {sorted(hit)} inside "
+                    f"@jit {fn.name}(): formatting forces abstract-value "
+                    "repr (or a sync once concrete); use jax.debug.print",
+                )
+
+
+def _run_r2(mod: _Module, add: AddFn) -> None:
+    aliases = mod.aliases
+    seen: Set[int] = set()
+    # decorator form
+    for fn in mod.walk_functions():
+        for dec in fn.decorator_list:
+            is_jit, call = _jit_call_of_decorator(dec, aliases)
+            if is_jit:
+                statics = _static_names_from_jit(call, fn, add)
+                if id(fn) not in seen:
+                    seen.add(id(fn))
+                    _check_jit_body(fn, statics, aliases, add)
+    # call form: jax.jit(func_name, ...)
+    for node in ast.walk(mod.tree):
+        if not isinstance(node, ast.Call):
+            continue
+        d = _canon(_dotted(node.func), aliases)
+        if d not in ("jax.jit", "jit") or not node.args:
+            continue
+        target = node.args[0]
+        if isinstance(target, ast.Name) and target.id in mod.functions:
+            fn = mod.functions[target.id]
+            statics = _static_names_from_jit(node, fn, add)
+            if id(fn) not in seen:
+                seen.add(id(fn))
+                _check_jit_body(fn, statics, aliases, add)
+
+
+# --------------------------------------------------------------------------
+# R3: dtype discipline
+
+
+def _simple_statements(tree: ast.Module):
+    """(enclosing_function_name, stmt) for statements that own their whole
+    subtree (no nested statements), so identifier context is local."""
+    for node in ast.walk(tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            fname = node.name
+            for sub in _own_nodes(node):
+                if isinstance(
+                    sub, (ast.Assign, ast.AnnAssign, ast.AugAssign, ast.Return, ast.Expr)
+                ):
+                    yield fname, sub
+
+
+def _run_r3(mod: _Module, dtype_strict: bool, add: AddFn) -> None:
+    aliases = mod.aliases
+    flagged: Set[Tuple[int, int]] = set()
+    for fname, stmt in _simple_statements(mod.tree):
+        idents = [fname]
+        for node in ast.walk(stmt):
+            if isinstance(node, ast.Name):
+                idents.append(node.id)
+            elif isinstance(node, ast.Attribute):
+                idents.append(node.attr)
+        if not _ITEMSIZE_CONTEXT_RE.search(" ".join(idents)):
+            continue
+        for node in ast.walk(stmt):
+            if not isinstance(node, ast.BinOp) or not isinstance(node.op, ast.Mult):
+                continue
+            for side in (node.left, node.right):
+                if (
+                    isinstance(side, ast.Constant)
+                    and side.value in (4, 8)
+                    and side.value is not True
+                    and (side.lineno, side.col_offset) not in flagged
+                ):
+                    flagged.add((side.lineno, side.col_offset))
+                    add(
+                        side.lineno,
+                        side.col_offset,
+                        "R3",
+                        f"hardcoded itemsize {side.value} in byte accounting; "
+                        "derive it from the array's dtype.itemsize (an x64 "
+                        "run makes this estimate wrong by 2x)",
+                    )
+    for node in ast.walk(mod.tree):
+        if not isinstance(node, ast.Call):
+            continue
+        d = _canon(_dotted(node.func), aliases)
+        if d == "numpy.float32":
+            add(
+                node.lineno,
+                node.col_offset,
+                "R3",
+                "np.float32(...) cast: derive the dtype from the data "
+                "(jnp.promote_types / x.dtype) instead of pinning f32",
+            )
+        elif isinstance(node.func, ast.Attribute) and node.func.attr == "astype":
+            if node.args:
+                arg = node.args[0]
+                ad = _canon(_dotted(arg), aliases)
+                if ad == "numpy.float32" or (
+                    isinstance(arg, ast.Constant) and arg.value == "float32"
+                ):
+                    add(
+                        node.lineno,
+                        node.col_offset,
+                        "R3",
+                        ".astype(float32) literal: derive the dtype from the "
+                        "data instead of pinning f32",
+                    )
+        elif dtype_strict and d in ("jax.numpy.array", "jax.numpy.asarray"):
+            has_dtype = len(node.args) >= 2 or any(
+                kw.arg == "dtype" for kw in node.keywords
+            )
+            if not has_dtype:
+                short = "jnp." + d.rsplit(".", 1)[1]
+                add(
+                    node.lineno,
+                    node.col_offset,
+                    "R3",
+                    f"{short}(...) without an explicit dtype in a "
+                    "dtype-strict module: the result silently follows the "
+                    "backend default; pass dtype= derived from the inputs",
+                )
+
+
+# --------------------------------------------------------------------------
+# R4: swallow-and-continue
+
+
+def _handler_is_accounted(handler: ast.ExceptHandler) -> bool:
+    """True when the handler re-raises at its top level or increments an obs
+    counter anywhere in its body. A call whose final segment ENDS WITH
+    ``swallowed_error`` also counts, so modules below obs in the import graph
+    can route through a lazy-import wrapper (e.g. ``_swallowed_error``)."""
+    for stmt in handler.body:
+        if isinstance(stmt, ast.Raise):
+            return True
+    for node in ast.walk(handler):
+        if isinstance(node, ast.Call):
+            d = _dotted(node.func)
+            seg = d.split(".")[-1] if d else ""
+            if seg == "inc" or seg.endswith("swallowed_error"):
+                return True
+    return False
+
+
+def _run_r4(mod: _Module, add: AddFn) -> None:
+    aliases = mod.aliases
+    for node in ast.walk(mod.tree):
+        if not isinstance(node, ast.ExceptHandler):
+            continue
+        broad = node.type is None
+        if node.type is not None:
+            types = (
+                node.type.elts if isinstance(node.type, ast.Tuple) else [node.type]
+            )
+            for t in types:
+                d = _canon(_dotted(t), aliases) or ""
+                if d.split(".")[-1] in ("Exception", "BaseException"):
+                    broad = True
+        if broad and not _handler_is_accounted(node):
+            add(
+                node.lineno,
+                node.col_offset,
+                "R4",
+                "broad except swallows errors invisibly: narrow the type, "
+                "re-raise at the handler's top level, or call "
+                "obs.swallowed_error(site) so the swallow shows up in "
+                "metrics.jsonl",
+            )
+
+
+# --------------------------------------------------------------------------
+
+
+def run_rules(
+    tree: ast.Module,
+    *,
+    hot: bool,
+    dtype_strict: bool,
+    rules: Optional[Sequence[str]] = None,
+) -> List[RawFinding]:
+    """All rule passes over one parsed module. ``hot`` enables R1;
+    ``dtype_strict`` enables R3's jnp.array-without-dtype subrule."""
+    mod = _Module(tree)
+    out: List[RawFinding] = []
+    enabled = set(rules) if rules is not None else set(RULES)
+
+    def adder(rule: str) -> AddFn:
+        def add(line: int, col: int, r: str, message: str) -> None:
+            if r in enabled:
+                out.append(RawFinding(line=line, col=col, rule=r, message=message))
+
+        return add
+
+    if hot and "R1" in enabled:
+        _run_r1(mod, adder("R1"))
+    if "R2" in enabled:
+        _run_r2(mod, adder("R2"))
+    if "R3" in enabled:
+        _run_r3(mod, dtype_strict, adder("R3"))
+    if "R4" in enabled:
+        _run_r4(mod, adder("R4"))
+    out.sort(key=lambda f: (f.line, f.col, f.rule))
+    return out

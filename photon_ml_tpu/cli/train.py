@@ -458,7 +458,8 @@ class _DaemonFuture:
         def _work():
             try:
                 self._value = fn()
-            except BaseException as e:  # re-raised in result()
+            # photon: ignore[R4] — future semantics: stored, re-raised in result()
+            except BaseException as e:
                 self._error = e
             finally:
                 self._done.set()

@@ -114,7 +114,12 @@ def build_device_evaluator(evaluators, labels: np.ndarray, weights):
     )
 
     def evaluate(scores) -> Dict[str, float]:
-        vals = np.asarray(compute(jnp.asarray(scores, y_dev.dtype), y_dev, w_dev))
+        from ..analysis.runtime import logged_fetch
+
+        vals = logged_fetch(
+            "evaluation.device_metrics",
+            compute(jnp.asarray(scores, y_dev.dtype), y_dev, w_dev),
+        )
         return {n: float(v) for n, v in zip(names, vals)}
 
     return evaluate

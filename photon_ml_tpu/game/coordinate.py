@@ -23,6 +23,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from ..analysis.runtime import logged_fetch
 from ..models.coefficients import Coefficients
 from ..models.game import FixedEffectModel, RandomEffectModel
 from ..models.glm import GeneralizedLinearModel, model_for_task
@@ -216,7 +217,10 @@ class RandomEffectCoordinate(Coordinate):
         multiproc = jax.process_count() > 1
         if multiproc or jax.default_backend() == "cpu":
             xp, xdt = np, np.dtype(jnp.zeros((), dtype).dtype)
-            to_host = np.asarray
+            # explicit logged fetch: warm-start/prior projections may land on
+            # device; the CD sweep runs under transfer_guard, which rejects
+            # a bare np.asarray on device arrays
+            to_host = lambda a: logged_fetch("coordinate.host_state", a)  # noqa: E731
         else:
             xp, xdt = jnp, dtype
             to_host = lambda a: a  # noqa: E731 — single decision point
@@ -404,10 +408,12 @@ class RandomEffectCoordinate(Coordinate):
         if pc_host is None:
             pc_host = ds.host_proj_cols
             if pc_host is None:
-                pc_host = np.asarray(ds.blocks.proj_cols)
+                pc_host = logged_fetch(
+                    "coordinate.layout_check", ds.blocks.proj_cols
+                )
             object.__setattr__(ds, "_host_proj_cols_cache", pc_host)
         ok = tuple(ci.shape) == tuple(np.shape(pc_host)) and np.array_equal(
-            np.asarray(ci), pc_host
+            logged_fetch("coordinate.layout_check", ci), pc_host
         )
         while len(memo) >= 8:  # bounded: drop oldest entries
             memo.pop(next(iter(memo)))
@@ -428,7 +434,10 @@ class RandomEffectCoordinate(Coordinate):
             same_layout = same_ids and self._support_layout_matches(model)
             sdt = np.dtype(ds.blocks.labels.dtype)  # solve/residual dtype
             if same_layout:
-                vals = np.asarray(model.coef_values, sdt)
+                vals = np.asarray(
+                    logged_fetch("coordinate.stream_score_model", model.coef_values),
+                    sdt,
+                )
             else:
                 # re-project a differently laid-out model into this dataset's
                 # entity/subspace layout on host (no device round trip)
@@ -573,7 +582,8 @@ def _entity_shard_align(blocks) -> int:
             chunk = sh.shard_shape(blocks.features.shape)[0]
             if chunk < blocks.features.shape[0]:
                 return int(chunk)
-    except Exception:
+    except AttributeError:
+        # host-numpy blocks (streamed datasets) carry no .sharding: unsharded
         pass
     return 1
 
@@ -613,37 +623,37 @@ def _project_model_values(
     # the dataset carries a host copy for layout checks and projection
     pc_host = dataset.host_proj_cols
     if pc_host is None:
-        pc_host = np.asarray(blocks.proj_cols)
+        pc_host = logged_fetch("coordinate.project_layout", blocks.proj_cols)
+    idx = np.asarray(
+        logged_fetch("coordinate.project_layout", model.coef_indices)
+    )
     if (
-        model.coef_indices.shape == (E, S)
+        idx.shape == (E, S)
         and model.num_entities == E
-        and np.array_equal(np.asarray(model.coef_indices), pc_host)
+        and np.array_equal(idx, pc_host)
         and list(map(str, model.entity_ids)) == list(map(str, dataset.entity_ids))
     ):
         # same layout: reuse directly
         if not to_device:
-            return np.asarray(values, dtype)
+            return np.asarray(
+                logged_fetch("coordinate.project_values", values), dtype
+            )
         return jnp.asarray(values, dtype)
     # general path: one vectorized sorted-key lookup over all (entity, column)
     # support pairs — no per-entity Python loop and no dense [E, global_dim]
     # intermediate, so re-projecting a large RE model from a differently
     # laid-out checkpoint stays O(nnz log nnz) host time.
-    dim = int(
-        max(
-            int(pc_host.max(initial=0)),
-            int(np.asarray(model.coef_indices).max(initial=0)),
-        )
-        + 1
-    )
-    vals = np.asarray(values)
-    idx = np.asarray(model.coef_indices)
+    dim = int(max(int(pc_host.max(initial=0)), int(idx.max(initial=0))) + 1)
+    vals = np.asarray(logged_fetch("coordinate.project_values", values))
     me, ms = np.nonzero(idx >= 0)
     mkeys = me.astype(np.int64) * dim + idx[me, ms]
     order = np.argsort(mkeys, kind="stable")
     mkeys_s = mkeys[order]
     mvals_s = vals[me, ms][order]
 
-    rows = np.asarray(model.rows_for(dataset.entity_ids))  # [E] model row or -1
+    rows = np.asarray(
+        jax.device_get(model.rows_for(dataset.entity_ids))
+    )  # [E] model row or -1
     pc = pc_host
     de, dsl = np.nonzero((pc >= 0) & (rows[:, None] >= 0))
     dkeys = rows[de].astype(np.int64) * dim + pc[de, dsl]

@@ -22,6 +22,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from .. import obs
+from ..analysis.runtime import logged_fetch, transfer_guard
 from ..evaluation.suite import EvaluationResults, EvaluationSuite
 from ..models.game import GameModel
 from ..optimize.trackers import build_tracker, record_tracker_metrics
@@ -128,57 +129,67 @@ class CoordinateDescent:
 
         for it in range(self.n_iterations):
             with obs.span("cd.sweep", iteration=it):
-                for name in self.order:
-                    coordinate = coords[name]
-                    own = scores.get(name)
-                    residual = summed - own if own is not None else summed
+                # zero-fetch invariant, runtime-enforced: inside the sweep
+                # every device->host transfer must be an explicit
+                # jax.device_get (logged_fetch) — an implicit fetch
+                # (float(arr), np.asarray(arr), arr.item()) raises instead of
+                # silently stalling the device pipeline. The static half of
+                # this contract is photon_ml_tpu.analysis rule R1.
+                with transfer_guard():
+                    for name in self.order:
+                        coordinate = coords[name]
+                        own = scores.get(name)
+                        residual = summed - own if own is not None else summed
 
-                    with obs.span("cd.coordinate", iteration=it, coordinate=name):
-                        with timed(f"cd iter {it} coordinate {name}: train"):
-                            model, solver_result = coordinate.train(
-                                residual, initial_model=models.get(name)
-                            )
-                        tracker = build_tracker(coordinate, solver_result)
-                        if tracker is not None:
-                            trackers[name] = tracker
-                            # logOptimizationSummary (CoordinateDescent.scala:
-                            # 230-248): per-coordinate convergence histogram /
-                            # iteration stats. Gated: both the summary string
-                            # and the metrics recording FETCH device arrays (a
-                            # ~100ms+ pipeline stall per fetch on remote
-                            # links); with INFO disabled and no telemetry sink
-                            # the sweep stays fetch-free
-                            if logger.isEnabledFor(logging.INFO):
-                                logger.info(
-                                    "cd iter %d coordinate %s optimization "
-                                    "summary:\n%s",
-                                    it,
-                                    name,
-                                    tracker.to_summary_string(),
+                        with obs.span("cd.coordinate", iteration=it, coordinate=name):
+                            with timed(f"cd iter {it} coordinate {name}: train"):
+                                model, solver_result = coordinate.train(
+                                    residual, initial_model=models.get(name)
                                 )
-                            if obs.active():
-                                record_tracker_metrics(
-                                    obs.current_run().registry, name, tracker
+                            tracker = build_tracker(coordinate, solver_result)
+                            if tracker is not None:
+                                trackers[name] = tracker
+                                # logOptimizationSummary (CoordinateDescent.scala:
+                                # 230-248): per-coordinate convergence histogram /
+                                # iteration stats. Gated: both the summary string
+                                # and the metrics recording FETCH device arrays (a
+                                # ~100ms+ pipeline stall per fetch on remote
+                                # links); with INFO disabled and no telemetry sink
+                                # the sweep stays fetch-free
+                                if logger.isEnabledFor(logging.INFO):
+                                    logger.info(
+                                        "cd iter %d coordinate %s optimization "
+                                        "summary:\n%s",
+                                        it,
+                                        name,
+                                        tracker.to_summary_string(),
+                                    )
+                                if obs.active():
+                                    record_tracker_metrics(
+                                        obs.current_run().registry, name, tracker
+                                    )
+                            models[name] = model
+
+                            with timed(f"cd iter {it} coordinate {name}: score"):
+                                new_scores = coordinate.score(model)
+                            # summedScores - oldScores + newScores (:441-446)
+                            summed = residual + new_scores
+                            scores[name] = new_scores
+
+                            if (
+                                self.validation is not None
+                                and self.validation_frequency == "COORDINATE"
+                            ):
+                                best_eval, best_models = self._track_best(
+                                    models, evaluations, best_eval, best_models, it, name
                                 )
-                        models[name] = model
-
-                        with timed(f"cd iter {it} coordinate {name}: score"):
-                            new_scores = coordinate.score(model)
-                        # summedScores - oldScores + newScores (:441-446)
-                        summed = residual + new_scores
-                        scores[name] = new_scores
-
-                        if (
-                            self.validation is not None
-                            and self.validation_frequency == "COORDINATE"
-                        ):
-                            best_eval, best_models = self._track_best(
-                                models, evaluations, best_eval, best_models, it, name
-                            )
-                if self.validation is not None and self.validation_frequency == "SWEEP":
-                    best_eval, best_models = self._track_best(
-                        models, evaluations, best_eval, best_models, it, self.order[-1]
-                    )
+                    if self.validation is not None and self.validation_frequency == "SWEEP":
+                        best_eval, best_models = self._track_best(
+                            models, evaluations, best_eval, best_models, it, self.order[-1]
+                        )
+                # checkpointing runs OUTSIDE the guard: serializers fetch
+                # model arrays however they like (np.asarray included), and a
+                # checkpoint is a deliberate pipeline sync point anyway
                 if self.checkpoint_fn is not None:
                     self.checkpoint_fn(it, dict(models))
             if obs.active():
@@ -248,5 +259,7 @@ class CoordinateDescent:
                 return res
         total = np.asarray(v.offsets, dtype=np.float64)
         if acc is not None:
-            total = total + np.asarray(acc, dtype=np.float64)
+            total = total + np.asarray(
+                logged_fetch("cd.validation_scores", acc), dtype=np.float64
+            )
         return v.suite.evaluate(total)

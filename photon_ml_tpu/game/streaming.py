@@ -39,9 +39,15 @@ import jax.numpy as jnp
 import numpy as np
 
 from .. import obs
+from ..analysis.runtime import logged_fetch
 from ..optimize import SolverResult
 
 Array = jax.Array
+
+# proj_cols / active_rows are int32 index planes (io/data.py builds them
+# that way); derived here so a future widening to int64 keeps the HBM
+# estimates honest instead of silently under-counting
+_INDEX_ITEMSIZE = int(np.dtype(np.int32).itemsize)
 
 
 def estimate_block_bytes(
@@ -57,8 +63,7 @@ def estimate_block_bytes(
     return (
         E * K * S * feature_itemsize
         + 3 * E * K * scalar_itemsize
-        + E * S * 4
-        + E * K * 4
+        + E * (S + K) * _INDEX_ITEMSIZE
     )
 
 
@@ -74,9 +79,11 @@ def entities_per_slice(
     (2 slices resident) plus ~4 [E_s, S] solver-state arrays per entity
     lane (w0/prior/coef/grad; the L-BFGS history is bounded separately by the
     solve itself). Solver state follows the label dtype (``scalar_itemsize``)."""
+    state_planes = 4  # w0 / prior-mean / coefficient / gradient per entity
     per_entity = (
-        2 * (K * S * feature_itemsize + 3 * K * scalar_itemsize + S * 4 + K * 4)
-        + 4 * S * scalar_itemsize
+        2 * (K * S * feature_itemsize + 3 * K * scalar_itemsize
+             + (S + K) * _INDEX_ITEMSIZE)
+        + state_planes * S * scalar_itemsize
     )
     e = max(budget_bytes // max(per_entity, 1), multiple)
     return int(e // multiple * multiple)
@@ -170,17 +177,12 @@ def solve_streamed(
 
     def collect(sl, res):
         s0, s1, _, sb = sl
-        coef = np.asarray(res.coefficients, sdt)
-        grad = np.asarray(res.gradient, sdt)
-        loss = np.asarray(res.loss, sdt)
-        iters = np.asarray(res.iterations)
-        reason = np.asarray(res.reason)
-        lh = np.asarray(res.loss_history, sdt)
-        gh = np.asarray(res.grad_norm_history, sdt)
-        obs.add_device_fetch_bytes(
+        coef, grad, loss, iters, reason, lh, gh = logged_fetch(
             "streaming.collect",
-            coef.nbytes + grad.nbytes + loss.nbytes + iters.nbytes
-            + reason.nbytes + lh.nbytes + gh.nbytes,
+            (
+                res.coefficients, res.gradient, res.loss, res.iterations,
+                res.reason, res.loss_history, res.grad_norm_history,
+            ),
         )
         out_coef[s0:s1, :sb] = coef
         out_grad[s0:s1, :sb] = grad
@@ -197,7 +199,7 @@ def solve_streamed(
         return (
             e * kb * sb * feat_itemsize
             + 3 * e * kb * sdt.itemsize
-            + e * kb * 4
+            + e * kb * blocks_np.active_rows.dtype.itemsize
             + 3 * e * sb * sdt.itemsize
         )
 
@@ -281,6 +283,8 @@ def score_streamed(
     E, S = coef_values_np.shape
     n = row_entity.shape[0]
     itemsize = np.dtype(coef_values_np.dtype).itemsize
+    # photon: ignore[R3] — the //8*8 below rounds to the 8-entity lane
+    # multiple (matches entities_per_slice), not an itemsize
     step = max(int(budget_bytes // max(S * itemsize * 2, 1)) // 8 * 8, 8)
     if score_dtype is None:
         score_dtype = jnp.promote_types(ell_val.dtype, jnp.float32)

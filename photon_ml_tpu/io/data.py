@@ -350,6 +350,9 @@ def read_avro_dataset(
                 raise
             import logging
 
+            from .. import obs
+
+            obs.swallowed_error("io.native_decode_fallback")
             logging.getLogger("photon_ml_tpu").warning(
                 "native Avro decode failed; falling back to Python codec",
                 exc_info=True,

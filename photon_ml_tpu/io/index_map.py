@@ -31,6 +31,10 @@ INTERCEPT_KEY = INTERCEPT_NAME + DELIMITER + INTERCEPT_TERM
 _MAGIC = b"PHIDX001"
 _MAGIC2 = b"PHIDX002"  # key-sorted, mmap-searchable (MmapIndexMap)
 
+# offsets and indices are stored little-endian int64 ("<q"); size every
+# header read from the dtype rather than a bare 8
+_I64 = np.dtype(np.int64).itemsize
+
 
 def feature_key(name: str, term: str = "") -> str:
     return name + DELIMITER + term
@@ -119,8 +123,8 @@ class IndexMap:
             if magic != _MAGIC:
                 raise ValueError(f"{path}: bad index store magic {magic!r}")
             (n,) = struct.unpack("<q", f.read(8))
-            offsets = np.frombuffer(f.read(8 * (n + 1)), dtype=np.int64)
-            indices = np.frombuffer(f.read(8 * n), dtype=np.int64)
+            offsets = np.frombuffer(f.read(_I64 * (n + 1)), dtype=np.int64)
+            indices = np.frombuffer(f.read(_I64 * n), dtype=np.int64)
             blob = f.read()
         k2i = {
             blob[offsets[k] : offsets[k + 1]].decode("utf-8"): int(indices[k])
@@ -284,9 +288,9 @@ class MmapIndexMap:
         off0 = 16
         offsets = np.frombuffer(mm, dtype=np.int64, count=n + 1, offset=off0)
         indices = np.frombuffer(
-            mm, dtype=np.int64, count=n, offset=off0 + 8 * (n + 1)
+            mm, dtype=np.int64, count=n, offset=off0 + _I64 * (n + 1)
         )
-        blob_start = off0 + 8 * (n + 1) + 8 * n
+        blob_start = off0 + _I64 * (n + 1) + _I64 * n
         return MmapIndexMap(mm, offsets, indices, blob_start, path)
 
 

@@ -28,6 +28,7 @@ from .run import (
     current_run,
     record_solver_metrics,
     set_current_run,
+    swallowed_error,
     use_run,
 )
 from .sinks import JsonlSink, PrometheusSink
@@ -63,5 +64,6 @@ __all__ = [
     "render_prometheus",
     "set_current_run",
     "span",
+    "swallowed_error",
     "use_run",
 ]

@@ -80,6 +80,20 @@ def active() -> bool:
     return _current.has_listeners()
 
 
+def swallowed_error(site: str) -> None:
+    """Count a deliberately swallowed exception so degraded-mode operation
+    is visible in metrics.jsonl (``photon_swallowed_errors_total{site=}``).
+
+    This is the instrumentation half of lint rule R4: a broad ``except``
+    that neither re-raises nor calls this is flagged as an invisible
+    swallow. Cheap host-only registry work — safe in any handler, including
+    inside event-dispatch error paths."""
+    _current.registry.counter(
+        "photon_swallowed_errors_total",
+        "exceptions swallowed by degrade-and-continue handlers",
+    ).labels(site=site).inc()
+
+
 def record_solver_metrics(solver: str, result) -> None:
     """Record iterations / convergence reasons / line-search failures /
     final gradient norms for a host-level solve.

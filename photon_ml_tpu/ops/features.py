@@ -255,8 +255,8 @@ def sorted_coo_matrix(
     sv[:m] = vals[order]
     return FeatureMatrix(
         dim=dim,
-        coo_cols=jnp.asarray(sc),
-        coo_rows=jnp.asarray(sr),
+        coo_cols=jnp.asarray(sc, np.int32),
+        coo_rows=jnp.asarray(sr, np.int32),
         coo_vals=jnp.asarray(sv, dtype),
         coo_n_rows=n_rows,
     )
@@ -304,7 +304,9 @@ def batch_from_coo(
         keep = within < k
         idx[r_s[keep], within[keep]] = c_s[keep]
         val[r_s[keep], within[keep]] = v_s[keep]
-        feats = FeatureMatrix(dim=dim, idx=jnp.asarray(idx), val=jnp.asarray(val, vdt))
+        feats = FeatureMatrix(
+            dim=dim, idx=jnp.asarray(idx, np.int32), val=jnp.asarray(val, vdt)
+        )
     return LabeledBatch(
         features=feats,
         labels=jnp.asarray(y, dtype),

@@ -17,7 +17,7 @@ from typing import Dict, Optional
 
 import numpy as np
 
-from ..obs import tracing
+from ..analysis.runtime import logged_fetch
 from .common import ConvergenceReason, SolverResult
 
 
@@ -59,13 +59,16 @@ class FixedEffectOptimizationTracker:
 
     def to_summary_string(self) -> str:
         r = self.result
-        reason = ConvergenceReason(int(np.asarray(r.reason))).name
-        losses = np.asarray(r.loss_history, dtype=np.float64)
+        reason_v, iters_v, loss_v, history = logged_fetch(
+            "tracker_summary", (r.reason, r.iterations, r.loss, r.loss_history)
+        )
+        reason = ConvergenceReason(int(reason_v)).name
+        losses = np.asarray(history, dtype=np.float64)
         losses = losses[np.isfinite(losses)]
         return (
             f"Convergence reason: {reason}\n"
-            f"Iterations: {int(np.asarray(r.iterations))}\n"
-            f"Loss: {float(np.asarray(r.loss)):.6g}"
+            f"Iterations: {int(iters_v)}\n"
+            f"Loss: {float(loss_v):.6g}"
             + (f" (initial {losses[0]:.6g})" if losses.size else "")
         )
 
@@ -89,11 +92,11 @@ class RandomEffectOptimizationTracker:
     def _aggregates(self):
         cached = self.__dict__.get("_agg")
         if cached is None:
-            reasons = np.asarray(self.result.reason).ravel()
-            iters = np.asarray(self.result.iterations).ravel()
-            tracing.add_device_fetch_bytes(
-                "tracker_aggregates", reasons.nbytes + iters.nbytes
+            reasons, iters = logged_fetch(
+                "tracker_aggregates", (self.result.reason, self.result.iterations)
             )
+            reasons = np.ravel(reasons)
+            iters = np.ravel(iters)
             if self.entity_mask is not None:
                 mask = np.asarray(self.entity_mask, dtype=bool).ravel()
                 reasons, iters = reasons[mask], iters[mask]
@@ -164,11 +167,14 @@ def record_tracker_metrics(registry, coordinate_name: str, tracker) -> None:
             reasons.labels(coordinate=coordinate_name, reason=reason).inc(n)
     else:
         r = tracker.result
-        iters.observe(int(np.asarray(r.iterations)))
+        iters_v, reason_v, loss_v = logged_fetch(
+            "tracker_metrics", (r.iterations, r.reason, r.loss)
+        )
+        iters.observe(int(iters_v))
         reasons.labels(
             coordinate=coordinate_name,
-            reason=ConvergenceReason(int(np.asarray(r.reason))).name,
+            reason=ConvergenceReason(int(reason_v)).name,
         ).inc()
         registry.gauge(
             "photon_cd_final_loss", "final training loss of the latest update"
-        ).labels(coordinate=coordinate_name).set(float(np.asarray(r.loss)))
+        ).labels(coordinate=coordinate_name).set(float(loss_v))

@@ -168,7 +168,10 @@ class TiledSparseMatrix:
         """
         d_loc, n_loc = self.d_local, self.n_local_rows
         if row_chunk is None:
-            mem_cap_rows = max((256 << 20) // (4 * max(self.dim, 1)), 1024)
+            row_itemsize = np.dtype(self.lval.dtype).itemsize
+            mem_cap_rows = max(
+                (256 << 20) // (row_itemsize * max(self.dim, 1)), 1024
+            )
             row_chunk = max(4096, min(-(-n_loc // 64), mem_cap_rows))
         chunk = min(row_chunk, n_loc)
         n_chunks = -(-n_loc // chunk)

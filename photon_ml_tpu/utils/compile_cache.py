@@ -36,6 +36,9 @@ def enable_persistent_compilation_cache(path: str | None = None) -> str | None:
         # only persist compiles worth the disk round trip
         jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
     except Exception as e:  # never fail a run over a cache
+        from .. import obs
+
+        obs.swallowed_error("compile_cache.enable")
         logger.info("persistent compilation cache unavailable: %s", e)
         return None
     return path
@@ -55,6 +58,9 @@ def install_compile_metrics_hook() -> bool:
     try:
         from jax._src import monitoring
     except Exception as e:  # private API: degrade to no compile attribution
+        from .. import obs
+
+        obs.swallowed_error("compile_cache.monitoring_import")
         logger.info("jax monitoring hook unavailable: %s", e)
         return False
 
@@ -75,6 +81,7 @@ def install_compile_metrics_hook() -> bool:
     try:
         monitoring.register_event_duration_secs_listener(_on_duration)
     except Exception as e:
+        obs.swallowed_error("compile_cache.monitoring_register")
         logger.info("jax monitoring hook registration failed: %s", e)
         return False
     _compile_hook_installed = True

@@ -17,6 +17,17 @@ from typing import Any, Dict, List, Optional
 logger = logging.getLogger("photon_ml_tpu")
 
 
+def _swallowed_error(site: str) -> None:
+    """Lazy obs.swallowed_error: utils.events sits BELOW obs in the import
+    graph (obs.run imports EventEmitter from here), so the counter import
+    must happen at call time; by then obs is always importable. Registry
+    increments emit no events, so counting inside event-dispatch error
+    handling cannot recurse."""
+    from .. import obs
+
+    obs.swallowed_error(site)
+
+
 class Event:
     """Base class of all emitted events."""
 
@@ -84,6 +95,7 @@ class EventEmitter:
                 try:
                     l.close()
                 except Exception:
+                    _swallowed_error("events.listener_close")
                     logger.exception("event listener close failed")
             self._listeners = []
 
@@ -94,6 +106,7 @@ class EventEmitter:
             try:
                 l.handle(event)
             except Exception:
+                _swallowed_error("events.listener_handle")
                 logger.exception(
                     "event listener %r failed on %s", l, type(event).__name__
                 )

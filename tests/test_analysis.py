@@ -1,0 +1,547 @@
+"""Static-analysis suite tests: one fixture trio per rule (fires /
+suppressed / clean), suppression + baseline mechanics, config loading, the
+CLI, and the runtime transfer guard."""
+
+import json
+import os
+import textwrap
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from photon_ml_tpu import obs
+from photon_ml_tpu.analysis import (
+    LintConfig,
+    allow_transfers,
+    analyze_paths,
+    analyze_source,
+    guard_level,
+    load_baseline,
+    load_config,
+    logged_fetch,
+    transfer_guard,
+    write_baseline,
+)
+from photon_ml_tpu.analysis.cli import main as lint_main
+
+HOT = "photon_ml_tpu/game/descent.py"  # matches default hot_loop_modules
+COLD = "photon_ml_tpu/models/somewhere.py"
+OPS = "photon_ml_tpu/ops/somekernel.py"  # matches default dtype_strict
+
+
+def findings(src, relpath=COLD, **kwargs):
+    return analyze_source(textwrap.dedent(src), relpath, **kwargs)
+
+
+def rules_of(fs):
+    return [f.rule for f in fs if f.active]
+
+
+# ---------------------------------------------------------------- R1
+
+
+R1_SRC = """
+    import jax.numpy as jnp
+
+    def f(scores):
+        s = jnp.sum(scores)
+        return float(s)
+    """
+
+
+def test_r1_fires_in_hot_module():
+    fs = findings(R1_SRC, HOT)
+    assert rules_of(fs) == ["R1"]
+    assert fs[0].code == "return float(s)"
+
+
+def test_r1_silent_outside_hot_modules():
+    assert rules_of(findings(R1_SRC, COLD)) == []
+
+
+def test_r1_suppressed_inline():
+    src = """
+    import jax.numpy as jnp
+
+    def f(scores):
+        s = jnp.sum(scores)
+        return float(s)  # photon: ignore[R1]
+    """
+    fs = findings(src, HOT)
+    assert rules_of(fs) == []
+    assert [f.rule for f in fs if f.suppressed] == ["R1"]
+
+
+def test_r1_clean_via_explicit_device_get():
+    src = """
+    import jax
+    import jax.numpy as jnp
+
+    def f(scores):
+        s = jnp.sum(scores)
+        return float(jax.device_get(s))
+    """
+    assert rules_of(findings(src, HOT)) == []
+
+
+def test_r1_np_asarray_on_device_value():
+    src = """
+    import jax.numpy as jnp
+    import numpy as np
+
+    def f(scores):
+        s = jnp.cumsum(scores)
+        return np.asarray(s)
+    """
+    assert rules_of(findings(src, HOT)) == ["R1"]
+
+
+def test_r1_annotation_taint_and_item():
+    src = """
+    import jax
+
+    def f(x: jax.Array):
+        return x.item()
+    """
+    assert rules_of(findings(src, HOT)) == ["R1"]
+
+
+def test_r1_host_attrs_stop_taint():
+    src = """
+    import jax.numpy as jnp
+
+    def f(scores):
+        s = jnp.sum(scores)
+        return float(s.shape[0])
+    """
+    assert rules_of(findings(src, HOT)) == []
+
+
+# ---------------------------------------------------------------- R2
+
+
+def test_r2_branch_on_tracer_in_jit():
+    src = """
+    import jax
+
+    @jax.jit
+    def f(x: jax.Array):
+        if x > 0:
+            return x
+        return -x
+    """
+    assert rules_of(findings(src)) == ["R2"]
+
+
+def test_r2_array_valued_static():
+    src = """
+    import jax
+    from functools import partial
+
+    @partial(jax.jit, static_argnums=(0,))
+    def f(x: jax.Array):
+        return x
+    """
+    assert rules_of(findings(src)) == ["R2"]
+
+
+def test_r2_fstring_of_tracer():
+    src = """
+    import jax
+
+    @jax.jit
+    def f(x: jax.Array):
+        print(f"value is {x}")
+        return x
+    """
+    assert rules_of(findings(src)) == ["R2"]
+
+
+def test_r2_clean_jit():
+    src = """
+    import jax
+    from functools import partial
+
+    @partial(jax.jit, static_argnames=("n",))
+    def f(x: jax.Array, n: int):
+        if n > 3:
+            return x * n
+        return x
+    """
+    assert rules_of(findings(src)) == []
+
+
+# ---------------------------------------------------------------- R3
+
+
+def test_r3_hardcoded_itemsize():
+    src = """
+    def block_bytes(n_rows, n_cols):
+        total_bytes = n_rows * n_cols * 4
+        return total_bytes
+    """
+    assert rules_of(findings(src)) == ["R3"]
+
+
+def test_r3_itemsize_needs_byte_context():
+    # a bare * 4 with no bytes/itemsize/budget context is not an itemsize
+    src = """
+    def quadruple(n):
+        total = n * 4
+        return total
+    """
+    assert rules_of(findings(src)) == []
+
+
+def test_r3_float32_literal_cast():
+    src = """
+    import numpy as np
+
+    def f(x):
+        return x.astype(np.float32)
+    """
+    assert rules_of(findings(src)) == ["R3"]
+
+
+def test_r3_dtype_strict_asarray():
+    src = """
+    import jax.numpy as jnp
+
+    def f(x):
+        return jnp.asarray(x)
+    """
+    assert rules_of(findings(src, OPS)) == ["R3"]
+    assert rules_of(findings(src, COLD)) == []  # only strict modules
+
+
+def test_r3_asarray_with_dtype_clean():
+    src = """
+    import jax.numpy as jnp
+    import numpy as np
+
+    def f(x):
+        return jnp.asarray(x, np.int32)
+    """
+    assert rules_of(findings(src, OPS)) == []
+
+
+# ---------------------------------------------------------------- R4
+
+
+def test_r4_swallowing_handler():
+    src = """
+    def f():
+        try:
+            work()
+        except Exception:
+            pass
+    """
+    assert rules_of(findings(src)) == ["R4"]
+
+
+def test_r4_bare_except():
+    src = """
+    def f():
+        try:
+            work()
+        except:
+            log()
+    """
+    assert rules_of(findings(src)) == ["R4"]
+
+
+def test_r4_counted_handler_clean():
+    src = """
+    def f():
+        try:
+            work()
+        except Exception:
+            obs.swallowed_error("site")
+    """
+    assert rules_of(findings(src)) == []
+
+
+def test_r4_reraising_handler_clean():
+    src = """
+    def f():
+        try:
+            work()
+        except Exception as e:
+            log(e)
+            raise
+    """
+    assert rules_of(findings(src)) == []
+
+
+def test_r4_narrow_handler_clean():
+    src = """
+    def f():
+        try:
+            work()
+        except ValueError:
+            pass
+    """
+    assert rules_of(findings(src)) == []
+
+
+# ----------------------------------------------------- suppression mechanics
+
+
+def test_standalone_comment_suppresses_next_line():
+    src = """
+    def f():
+        try:
+            work()
+        # photon: ignore[R4] — close() failures are best-effort by contract
+        except Exception:
+            pass
+    """
+    fs = findings(src)
+    assert rules_of(fs) == []
+    assert [f.rule for f in fs if f.suppressed] == ["R4"]
+
+
+def test_docstring_mention_does_not_suppress():
+    src = '''
+    def f():
+        """Suppress with  # photon: ignore[R4]  on the offending line."""
+        try:
+            work()
+        except Exception:
+            pass
+    '''
+    assert rules_of(findings(src)) == ["R4"]
+
+
+def test_unknown_rule_in_ignore_is_an_error():
+    src = """
+    x = 1  # photon: ignore[R9]
+    """
+    with pytest.raises(ValueError, match="unknown rule"):
+        findings(src)
+
+
+# ---------------------------------------------------------------- baseline
+
+
+BAD_MODULE = textwrap.dedent(
+    """
+    def f():
+        try:
+            work()
+        except Exception:
+            pass
+    """
+)
+
+
+def _mini_repo(tmp_path, source=BAD_MODULE):
+    pkg = tmp_path / "pkg"
+    pkg.mkdir(exist_ok=True)
+    (pkg / "mod.py").write_text(source)
+    return LintConfig(paths=("pkg",), root=str(tmp_path))
+
+
+def test_baseline_roundtrip(tmp_path):
+    cfg = _mini_repo(tmp_path)
+    result = analyze_paths(config=cfg)
+    assert [f.rule for f in result.active] == ["R4"]
+
+    write_baseline(result.findings, cfg.baseline_path)
+    baseline = load_baseline(cfg.baseline_path)
+    again = analyze_paths(config=cfg, baseline=baseline)
+    assert again.active == []
+    assert [f.rule for f in again.findings if f.baselined] == ["R4"]
+
+
+def test_baseline_is_a_multiset(tmp_path):
+    cfg = _mini_repo(tmp_path)
+    write_baseline(analyze_paths(config=cfg).findings, cfg.baseline_path)
+    # a SECOND identical offending handler is NOT grandfathered
+    (tmp_path / "pkg" / "mod.py").write_text(BAD_MODULE + BAD_MODULE.replace("f()", "g()"))
+    result = analyze_paths(config=cfg, baseline=load_baseline(cfg.baseline_path))
+    assert len([f for f in result.findings if f.rule == "R4"]) == 2
+    assert len(result.active) == 1
+
+
+def test_missing_baseline_is_empty(tmp_path):
+    assert load_baseline(str(tmp_path / "nope.json")) == {}
+
+
+# ---------------------------------------------------------------- config
+
+
+def test_load_config_from_pyproject(tmp_path):
+    py = tmp_path / "pyproject.toml"
+    py.write_text(
+        textwrap.dedent(
+            """
+            [project]
+            name = "x"
+
+            [tool.photon-lint]
+            paths = ["pkg"]
+            baseline = "base.json"
+            hot_loop_modules = [
+                "pkg/hot.py",  # trailing comment
+                "pkg/loops/*",
+            ]
+            """
+        )
+    )
+    cfg = load_config(pyproject=str(py))
+    assert cfg.paths == ("pkg",)
+    assert cfg.baseline == "base.json"
+    assert cfg.hot_loop_modules == ("pkg/hot.py", "pkg/loops/*")
+    assert cfg.is_hot("pkg/loops/inner.py")
+    assert not cfg.is_hot("pkg/cold.py")
+    assert cfg.root == str(tmp_path)
+
+
+def test_load_config_rejects_unknown_keys(tmp_path):
+    py = tmp_path / "pyproject.toml"
+    py.write_text("[tool.photon-lint]\ntypo_key = 1\n")
+    with pytest.raises(ValueError, match="unknown keys"):
+        load_config(pyproject=str(py))
+
+
+# ---------------------------------------------------------------- CLI
+
+
+def _write_pyproject(tmp_path):
+    (tmp_path / "pyproject.toml").write_text(
+        '[tool.photon-lint]\npaths = ["pkg"]\n'
+    )
+    return str(tmp_path / "pyproject.toml")
+
+
+def test_cli_exit_codes_and_json(tmp_path, capsys):
+    _mini_repo(tmp_path)
+    py = _write_pyproject(tmp_path)
+
+    assert lint_main(["--config", py, "--json"]) == 1
+    report = json.loads(capsys.readouterr().out)
+    assert report["active"] == 1 and not report["ok"]
+    assert report["findings"][0]["rule"] == "R4"
+
+    assert lint_main(["--config", py, "--write-baseline"]) == 0
+    capsys.readouterr()
+    assert lint_main(["--config", py]) == 0  # baselined now
+    assert "baselined" in capsys.readouterr().out
+
+    # a clean tree exits 0 with no baseline at all
+    (tmp_path / "pkg" / "mod.py").write_text("x = 1\n")
+    os.remove(tmp_path / "lint_baseline.json")
+    assert lint_main(["--config", py]) == 0
+
+
+def test_cli_rule_filter(tmp_path, capsys):
+    _mini_repo(tmp_path)
+    py = _write_pyproject(tmp_path)
+    assert lint_main(["--config", py, "--rule", "R1"]) == 0
+    assert lint_main(["--config", py, "--rule", "R4"]) == 1
+    capsys.readouterr()
+
+
+def test_cli_list_rules(capsys):
+    assert lint_main(["--list-rules"]) == 0
+    out = capsys.readouterr().out
+    for rule in ("R1", "R2", "R3", "R4"):
+        assert rule in out
+
+
+def test_cli_parse_error_fails(tmp_path, capsys):
+    _mini_repo(tmp_path, source="def broken(:\n")
+    py = _write_pyproject(tmp_path)
+    assert lint_main(["--config", py]) == 1
+    assert "parse error" in capsys.readouterr().err
+
+
+# ------------------------------------------------------------ runtime guard
+
+
+def _backend_enforces_guard() -> bool:
+    """XLA only intercepts device->host copies that are real DMAs; on the
+    CPU backend device buffers alias host memory and the guard is a no-op."""
+    import jax
+
+    x = jnp.arange(4, dtype=jnp.float32)
+    try:
+        with jax.transfer_guard_device_to_host("disallow"):
+            float(jnp.sum(x))
+    except Exception:
+        return True
+    return False
+
+
+@pytest.mark.skipif(
+    not _backend_enforces_guard(),
+    reason="backend does not route d2h through the transfer guard (CPU zero-copy)",
+)
+def test_transfer_guard_blocks_implicit_fetch():
+    x = jnp.arange(8, dtype=jnp.float32)
+    with transfer_guard():
+        with pytest.raises(Exception, match="[Dd]isallow"):
+            float(jnp.sum(x))
+
+
+def test_transfer_guard_level_plumbing():
+    assert guard_level() is None
+    with transfer_guard():
+        assert guard_level() == "disallow"
+        with allow_transfers():
+            assert guard_level() == "allow"
+        assert guard_level() == "disallow"
+    assert guard_level() is None
+
+
+def test_transfer_guard_level_restored_on_error():
+    with pytest.raises(RuntimeError):
+        with transfer_guard():
+            raise RuntimeError("boom")
+    assert guard_level() is None
+
+
+def test_logged_fetch_allowed_and_counted_under_guard():
+    x = jnp.arange(8, dtype=jnp.float32)
+    with obs.use_run(obs.RunTelemetry()) as run:
+        with transfer_guard():
+            out = logged_fetch("test.fetch", x)
+        assert isinstance(out, np.ndarray)
+        assert out.nbytes == 32
+        snap = {
+            (m["name"], m["labels"].get("site")): m["value"]
+            for m in run.registry.snapshot()
+        }
+        assert snap[("photon_device_fetch_bytes_total", "test.fetch")] == 32.0
+
+
+def test_logged_fetch_numpy_passthrough_uncounted():
+    a = np.arange(4.0)
+    with obs.use_run(obs.RunTelemetry()) as run:
+        out = logged_fetch("test.noop", a)
+        assert out is a
+        assert run.registry.snapshot() == []
+
+
+def test_allow_transfers_lifts_guard():
+    x = jnp.ones((3,))
+    with transfer_guard():
+        with allow_transfers():
+            assert float(jnp.sum(x)) == 3.0
+
+
+def test_guard_env_override_off(monkeypatch):
+    monkeypatch.setenv("PHOTON_TRANSFER_GUARD", "off")
+    x = jnp.ones((2,))
+    with transfer_guard():
+        assert guard_level() == "allow"
+        assert float(jnp.sum(x)) == 2.0
+
+
+def test_guard_env_override_invalid(monkeypatch):
+    monkeypatch.setenv("PHOTON_TRANSFER_GUARD", "sideways")
+    with pytest.raises(ValueError, match="PHOTON_TRANSFER_GUARD"):
+        with transfer_guard():
+            pass

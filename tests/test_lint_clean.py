@@ -1,0 +1,26 @@
+"""Tier-1 self-check: the package passes its own static analysis.
+
+This is the CI gate in test form — the same configuration, baseline, and
+rule set as ``python -m photon_ml_tpu.analysis``. A new unsuppressed finding
+anywhere in the configured paths (photon_ml_tpu/ and bench.py) fails this
+test with the finding list in the assertion message; fix it, suppress it
+with a reasoned ``# photon: ignore[Rn]``, or (for a deliberate
+grandfathering) add it to lint_baseline.json via --write-baseline."""
+
+import os
+
+from photon_ml_tpu.analysis import analyze_paths, load_baseline, load_config
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def test_package_is_lint_clean():
+    config = load_config(pyproject=os.path.join(REPO_ROOT, "pyproject.toml"))
+    baseline = load_baseline(config.baseline_path)
+    result = analyze_paths(config=config, baseline=baseline)
+    assert not result.parse_errors, result.parse_errors
+    assert not result.active, "\n" + "\n".join(
+        f"{f.file}:{f.line}:{f.col}: {f.rule} {f.message}\n    {f.code}"
+        for f in result.active
+    )
+    assert result.files_scanned > 50  # the walk really covered the package
