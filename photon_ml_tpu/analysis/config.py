@@ -32,6 +32,10 @@ DEFAULT_HOT_LOOP_MODULES: Tuple[str, ...] = (
     "photon_ml_tpu/optimize/*",
 )
 DEFAULT_DTYPE_STRICT_MODULES: Tuple[str, ...] = ("photon_ml_tpu/ops/*",)
+DEFAULT_ATOMIC_WRITE_MODULES: Tuple[str, ...] = (
+    "photon_ml_tpu/io/*",
+    "photon_ml_tpu/robust/*",
+)
 
 
 def _match(relpath: str, patterns: Sequence[str]) -> bool:
@@ -53,6 +57,7 @@ class LintConfig:
     exclude: Tuple[str, ...] = ()
     hot_loop_modules: Tuple[str, ...] = DEFAULT_HOT_LOOP_MODULES
     dtype_strict_modules: Tuple[str, ...] = DEFAULT_DTYPE_STRICT_MODULES
+    atomic_write_modules: Tuple[str, ...] = DEFAULT_ATOMIC_WRITE_MODULES
     root: str = "."
 
     def is_hot(self, relpath: str) -> bool:
@@ -60,6 +65,9 @@ class LintConfig:
 
     def is_dtype_strict(self, relpath: str) -> bool:
         return _match(relpath, self.dtype_strict_modules)
+
+    def is_atomic_write(self, relpath: str) -> bool:
+        return _match(relpath, self.atomic_write_modules)
 
     def is_excluded(self, relpath: str) -> bool:
         return _match(relpath, self.exclude)

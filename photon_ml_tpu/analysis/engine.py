@@ -129,6 +129,7 @@ def analyze_source(
         tree,
         hot=config.is_hot(relpath),
         dtype_strict=config.is_dtype_strict(relpath),
+        atomic=config.is_atomic_write(relpath),
         rules=rules,
     )
     sup = _suppressions(source)

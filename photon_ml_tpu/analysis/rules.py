@@ -30,6 +30,13 @@ R4 swallow-and-continue: ``except Exception`` (or bare ``except``) whose
    — errors that vanish without a trace in metrics.jsonl. Narrow the
    exception type, re-raise, or call ``obs.swallowed_error(site)``.
 
+R5 non-atomic-write: a direct ``open(..., "w"/"a"/"x")`` (or ``io.open``)
+   in the configured atomic-write modules (``io/``, ``robust/``). A crash
+   mid-write leaves a torn file the next run half-reads; persistence in
+   those trees must go through ``robust.atomic.atomic_write*`` (temp +
+   fsync + rename), or carry an explicit ``# photon: ignore[R5]`` stating
+   why rename semantics are wrong (e.g. append-only logs).
+
 Taint tracking is deliberately local and conservative: names become
 "jax-typed" through parameter annotations (``Array``, ``jax.Array``, ...)
 and through assignment from expressions rooted at ``jnp.`` / ``jax.`` calls
@@ -51,6 +58,7 @@ RULES: Dict[str, str] = {
     "R2": "recompile hazard inside a @jit function",
     "R3": "dtype discipline (hardcoded itemsize / dtype literal)",
     "R4": "swallowed exception (no re-raise, no obs counter)",
+    "R5": "non-atomic file write in an atomic-write module",
 }
 
 # attributes whose value is host metadata, not an array: reading them off a
@@ -703,6 +711,54 @@ def _run_r4(mod: _Module, add: AddFn) -> None:
 
 
 # --------------------------------------------------------------------------
+# R5: non-atomic file writes in atomic-write modules
+
+
+def _open_write_mode(node: ast.Call, aliases: Dict[str, str]) -> Optional[str]:
+    """The literal write mode of an ``open()`` / ``io.open()`` call, or None
+    when the call isn't an open or the mode isn't a write mode. A non-literal
+    mode is returned as ``"?"`` (flagged: it may be a write)."""
+    d = _canon(_dotted(node.func), aliases)
+    if d not in ("open", "io.open"):
+        return None
+    mode_node: Optional[ast.AST] = None
+    if len(node.args) >= 2:
+        mode_node = node.args[1]
+    for kw in node.keywords:
+        if kw.arg == "mode":
+            mode_node = kw.value
+    if mode_node is None:
+        return None  # default "r"
+    if isinstance(mode_node, ast.Constant) and isinstance(mode_node.value, str):
+        mode = mode_node.value
+        return mode if any(c in mode for c in "wax+") else None
+    return "?"
+
+
+def _run_r5(mod: _Module, add: AddFn) -> None:
+    aliases = mod.aliases
+    for node in ast.walk(mod.tree):
+        if not isinstance(node, ast.Call):
+            continue
+        mode = _open_write_mode(node, aliases)
+        if mode is None:
+            continue
+        what = (
+            f"open(..., {mode!r})"
+            if mode != "?"
+            else "open() with a non-literal mode"
+        )
+        add(
+            node.lineno,
+            node.col_offset,
+            "R5",
+            f"{what} in an atomic-write module: a crash mid-write leaves a "
+            "torn file; write through robust.atomic.atomic_write* "
+            "(temp+fsync+rename) or justify with # photon: ignore[R5]",
+        )
+
+
+# --------------------------------------------------------------------------
 
 
 def run_rules(
@@ -710,10 +766,12 @@ def run_rules(
     *,
     hot: bool,
     dtype_strict: bool,
+    atomic: bool = False,
     rules: Optional[Sequence[str]] = None,
 ) -> List[RawFinding]:
     """All rule passes over one parsed module. ``hot`` enables R1;
-    ``dtype_strict`` enables R3's jnp.array-without-dtype subrule."""
+    ``dtype_strict`` enables R3's jnp.array-without-dtype subrule;
+    ``atomic`` enables R5 (direct-write detection in persistence modules)."""
     mod = _Module(tree)
     out: List[RawFinding] = []
     enabled = set(rules) if rules is not None else set(RULES)
@@ -733,5 +791,7 @@ def run_rules(
         _run_r3(mod, dtype_strict, adder("R3"))
     if "R4" in enabled:
         _run_r4(mod, adder("R4"))
+    if atomic and "R5" in enabled:
+        _run_r5(mod, adder("R5"))
     out.sort(key=lambda f: (f.line, f.col, f.rule))
     return out

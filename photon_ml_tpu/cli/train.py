@@ -35,6 +35,7 @@ from ..io import read_avro_dataset, save_game_model
 from ..io.index_map import IndexMap
 from ..io.model_io import load_game_model
 from ..parallel import multihost
+from ..robust import CheckpointManager, atomic_write, atomic_write_json, faults
 from ..ops.normalization import build_normalization
 from ..tuning.rescaling import HyperparameterConfig, ParamRange
 from ..tuning.tuner import get_tuner
@@ -145,6 +146,30 @@ def build_parser() -> argparse.ArgumentParser:
         "resumes from the last completed unit (crash recovery for long runs)",
     )
     p.add_argument(
+        "--checkpoint-every",
+        type=int,
+        default=0,
+        help="additionally snapshot the full coordinate-descent outer-loop "
+        "state every N coordinate-update boundaries under "
+        "<checkpoint-dir>/cd-boundaries (crash-safe: temp+fsync+rename, "
+        "digest-bearing manifest); 0 disables. Requires --checkpoint-dir",
+    )
+    p.add_argument(
+        "--checkpoint-keep",
+        type=int,
+        default=3,
+        help="boundary checkpoints retained (keep-last-K rotation)",
+    )
+    p.add_argument(
+        "--resume",
+        action="store_true",
+        help="resume from the newest VALID boundary checkpoint under "
+        "<checkpoint-dir>/cd-boundaries (corrupt ones are skipped with a "
+        "warning); training continues from the coordinate update after the "
+        "snapshot, bit-identical to the uninterrupted run. Requires "
+        "--checkpoint-dir",
+    )
+    p.add_argument(
         "--distributed",
         default=None,
         help="multi-host: 'coordinator=HOST:PORT,process=I,n=P' (or 'auto' "
@@ -168,6 +193,10 @@ def build_parser() -> argparse.ArgumentParser:
 def run(argv: Optional[List[str]] = None) -> Dict:
     args = build_parser().parse_args(argv)
     setup_logging(args.log_level, args.log_file)
+    # PHOTON_FAULTS / PHOTON_FAULTS_SEED: deterministic fault injection at IO
+    # and checkpoint boundaries (robust.faults); absent env clears any
+    # injector a previous in-process run installed
+    faults.install_from_env()
 
     from ..utils.compile_cache import enable_persistent_compilation_cache
 
@@ -368,11 +397,42 @@ def _run_training(args, run_t, metric_sinks, t_run0) -> Dict:
             datasets_cache["d"] = estimator.prepare_datasets(raw)
         return datasets_cache["d"]
 
+    # fine-grained crash safety (robust.checkpoint): snapshot the CD
+    # outer-loop state at coordinate-update boundaries, resume bit-exact
+    cd_manager = None
+    resume_snap = None
+    if args.checkpoint_every:
+        if not args.checkpoint_dir:
+            raise SystemExit("--checkpoint-every requires --checkpoint-dir")
+        cd_manager = CheckpointManager(
+            os.path.join(args.checkpoint_dir, "cd-boundaries"),
+            keep_last=args.checkpoint_keep,
+            every=args.checkpoint_every,
+        )
+    if args.resume:
+        if not args.checkpoint_dir:
+            raise SystemExit("--resume requires --checkpoint-dir")
+        mgr = cd_manager or CheckpointManager(
+            os.path.join(args.checkpoint_dir, "cd-boundaries"),
+            keep_last=args.checkpoint_keep,
+        )
+        # boundary checkpoints are coordinator-written; load there and
+        # broadcast so non-shared filesystems resume consistently
+        if multihost.is_coordinator():
+            resume_snap = mgr.latest_valid(
+                expect_coordinate_order=[cc.name for cc in coords]
+            )
+        if multihost.process_count() > 1:
+            resume_snap = multihost.broadcast_object(resume_snap)
+        if resume_snap is None:
+            logger.info("--resume: no valid boundary checkpoint; starting fresh")
+
     with obs.span("train"):
         if args.checkpoint_dir:
             ckpt = _Checkpoint.open(args, coords, index_maps)
             results = ckpt.fit_grid(
-                estimator, raw, validation, get_datasets, initial_model
+                estimator, raw, validation, get_datasets, initial_model,
+                cd_manager=cd_manager, resume_snapshot=resume_snap,
             )
         else:
             results = estimator.fit(
@@ -386,6 +446,7 @@ def _run_training(args, run_t, metric_sinks, t_run0) -> Dict:
             tuned_results = _run_tuning(
                 args, estimator, raw, _resolve_validation(validation), coords,
                 results, ckpt=ckpt, datasets_fn=get_datasets,
+                resume_snap=resume_snap,
             )
 
     all_results = list(results) + tuned_results
@@ -411,17 +472,19 @@ def _run_training(args, run_t, metric_sinks, t_run0) -> Dict:
         )
         doc["task"] = summary["task"]
         doc["best"] = summary["best"]
-        tmp = os.path.join(args.metrics_out, "run_summary.json.tmp")
-        with open(tmp, "w") as f:
-            json.dump(doc, f, indent=2, default=float)
-        os.replace(tmp, os.path.join(args.metrics_out, "run_summary.json"))
+        atomic_write_json(
+            os.path.join(args.metrics_out, "run_summary.json"),
+            doc, indent=2, default=float,
+        )
     if not multihost.is_coordinator():
         # only process 0 writes outputs (the reference's driver-to-HDFS role)
         return summary
 
     os.makedirs(args.output_dir, exist_ok=True)
-    with open(os.path.join(args.output_dir, "training-summary.json"), "w") as f:
-        json.dump(summary, f, indent=2, default=float)
+    atomic_write_json(
+        os.path.join(args.output_dir, "training-summary.json"),
+        summary, indent=2, default=float,
+    )
 
     to_save = all_results if args.output_mode == OUTPUT_MODE_ALL else [best]
     for i, r in enumerate(to_save):
@@ -487,7 +550,7 @@ def _resolve_validation(validation):
 
 
 def _run_tuning(args, estimator, raw, validation, coords, prior_results,
-                ckpt=None, datasets_fn=None):
+                ckpt=None, datasets_fn=None, resume_snap=None):
     """GP/random tuning over per-coordinate log10 reg weights
     (GameEstimatorEvaluationFunction semantics: candidate <-> (log lambda,...)).
 
@@ -513,6 +576,7 @@ def _run_tuning(args, estimator, raw, validation, coords, prior_results,
     results: List[GameResult] = []
 
     def evaluate(unit_vec):
+        faults.check("tuning.trial")
         native = hp.scale_up(unit_vec)
         weights = {
             n.removesuffix(".reg_weight"): float(v) for n, v in zip(names, native)
@@ -577,6 +641,20 @@ def _run_tuning(args, estimator, raw, validation, coords, prior_results,
                 )
             )
         n_done = len(ckpt.completed_trials())
+        if resume_snap is not None:
+            # boundary manifests record the trial count at write time; a
+            # lost/older checkpoint-state.json must not replay candidates the
+            # manifest proves were already drawn — burn those candidates
+            # (their observations are gone, but a deterministic tuner's
+            # sequence stays aligned via skip=)
+            from_manifest = int(resume_snap.manifest.get("tuner_trials", 0))
+            if from_manifest > n_done:
+                logger.warning(
+                    "checkpoint manifest records %d tuning trials but state "
+                    "has %d; skipping the %d lost candidates",
+                    from_manifest, n_done, from_manifest - n_done,
+                )
+                n_done = from_manifest
         if n_done:
             logger.info("checkpoint: %d/%d tuning trials already run", n_done, n_iter)
         n_iter = max(n_iter - n_done, 0)
@@ -604,7 +682,9 @@ def _run_tuning(args, estimator, raw, validation, coords, prior_results,
 
     if multihost.is_coordinator():
         os.makedirs(args.output_dir, exist_ok=True)
-        with open(os.path.join(args.output_dir, "hyperparameter-prior.json"), "w") as f:
+        with atomic_write(
+            os.path.join(args.output_dir, "hyperparameter-prior.json"), "w"
+        ) as f:
             f.write(prior_to_json(names, priors))
     return results
 
@@ -718,9 +798,7 @@ class _Checkpoint:
 
         if not multihost.is_coordinator():
             return
-        with open(self.state_path + ".tmp", "w") as f:
-            json.dump(self.state, f)
-        os.replace(self.state_path + ".tmp", self.state_path)  # atomic flip
+        atomic_write_json(self.state_path, self.state)
 
     def _load_model(self, model_dir):
         # model files exist only where the coordinator wrote them
@@ -763,7 +841,15 @@ class _Checkpoint:
             trackers={},
         )
 
-    def fit_grid(self, estimator, raw, validation, datasets_fn, initial_model):
+    def fit_grid(self, estimator, raw, validation, datasets_fn, initial_model,
+                 cd_manager=None, resume_snapshot=None):
+        """``cd_manager`` (robust.CheckpointManager) adds coordinate-update-
+        boundary snapshots on top of the per-sweep model saves;
+        ``resume_snapshot`` (robust.CheckpointSnapshot) resumes its combo
+        mid-sweep, bit-identical. The two granularities compose: whichever
+        record is further along wins, and boundary manifests carry
+        ``combo_index`` / ``sweep_offset`` so a snapshot written during a
+        sweep-level-resumed run still maps back to global sweep numbering."""
         import shutil
 
         # checkpointed grids read validation directly (recovered-metric
@@ -789,6 +875,33 @@ class _Checkpoint:
             cur = self.state.get("current")
             if cur and cur.get("index") == k and cur.get("completed_sweeps", 0) > 0:
                 done = int(cur["completed_sweeps"])
+            snap = None
+            if (
+                resume_snapshot is not None
+                and int(resume_snapshot.manifest.get("combo_index", -1)) == k
+            ):
+                snap = resume_snapshot
+                # global sweep the snapshot sits in = offset of the run that
+                # wrote it + its local iteration; an older sweep-level record
+                # must not win over it (and vice versa)
+                snap_global = int(snap.manifest.get("sweep_offset", 0)) + int(
+                    snap.iteration
+                )
+                if snap_global < done:
+                    logger.info(
+                        "config %d: per-sweep record (sweep %d) is ahead of "
+                        "the boundary snapshot (sweep %d); using the former",
+                        k, done, snap_global,
+                    )
+                    snap = None
+            if snap is not None:
+                done = int(snap.manifest.get("sweep_offset", 0))
+                logger.info(
+                    "resuming config %d from boundary snapshot %s "
+                    "(iter %d after coordinate %s)",
+                    k, snap.path, snap.iteration, snap.coordinate,
+                )
+            elif done > 0:
                 prev = self._load_model(cur["model_dir"])
                 logger.info(
                     "resuming config %d from sweep %d/%d", k, done, n_iter
@@ -808,6 +921,41 @@ class _Checkpoint:
 
                 if multihost.is_coordinator() and os.path.isdir(prev_dir):
                     shutil.rmtree(prev_dir, ignore_errors=True)
+
+            boundary = None
+            if cd_manager is not None:
+                n_trials = len(self.state.get("tuning_trials", []))
+
+                def boundary(reg_weights, st, _k=k, _done=done, _n=n_trials):
+                    # coordinator-only like _save_model: boundary snapshots
+                    # live on the coordinator's filesystem and broadcast on
+                    # resume
+                    if multihost.is_coordinator():
+                        cd_manager.on_boundary(
+                            st,
+                            meta={
+                                "reg_weights": reg_weights,
+                                "combo_index": _k,
+                                "sweep_offset": _done,
+                                "tuner_trials": _n,
+                            },
+                        )
+
+            if snap is not None:
+                # fine-grained resume: descent continues mid-sweep from the
+                # snapshot (full per-call iteration count of the run that
+                # wrote it; resume_state overrides initial models)
+                r = estimator.fit(
+                    raw, validation=validation, initial_model=prev,
+                    checkpoint_fn=sweep_fn, datasets=datasets_fn(),
+                    combos=[combos[k]],
+                    n_cd_iterations=int(snap.manifest["n_iterations"]),
+                    boundary_fn=boundary, resume_state=snap,
+                )[0]
+                self._finish_combo(k, combos, r, n_iter)
+                results.append(r)
+                prev = r.model
+                continue
 
             remaining = n_iter - done
             if remaining <= 0:
@@ -831,29 +979,37 @@ class _Checkpoint:
                     raw, validation=validation, initial_model=prev,
                     checkpoint_fn=sweep_fn, datasets=datasets_fn(),
                     combos=[combos[k]], n_cd_iterations=remaining,
+                    boundary_fn=boundary,
                 )[0]
-            final_dir = f"config-{k:03d}-final"
-            self._save_model(final_dir, r.model, combos[k])
-            self.state["completed"].append(
-                {
-                    "reg_weights": combos[k],
-                    "model_dir": final_dir,
-                    "metrics": None if r.evaluation is None else r.evaluation.metrics,
-                    "primary_name": None
-                    if r.evaluation is None
-                    else r.evaluation.primary_name,
-                }
-            )
-            self.state["current"] = None
-            self._write()
-
-            if multihost.is_coordinator():
-                last = os.path.join(self.dir, f"config-{k:03d}-sweep-{n_iter:04d}")
-                if os.path.isdir(last):
-                    shutil.rmtree(last, ignore_errors=True)
+            self._finish_combo(k, combos, r, n_iter)
             results.append(r)
             prev = r.model
         return results
+
+    def _finish_combo(self, k, combos, r: GameResult, n_iter):
+        """Record config ``k`` as completed: final model, metrics, state
+        flip, per-sweep model cleanup."""
+        import shutil
+
+        final_dir = f"config-{k:03d}-final"
+        self._save_model(final_dir, r.model, combos[k])
+        self.state["completed"].append(
+            {
+                "reg_weights": combos[k],
+                "model_dir": final_dir,
+                "metrics": None if r.evaluation is None else r.evaluation.metrics,
+                "primary_name": None
+                if r.evaluation is None
+                else r.evaluation.primary_name,
+            }
+        )
+        self.state["current"] = None
+        self._write()
+
+        if multihost.is_coordinator():
+            last = os.path.join(self.dir, f"config-{k:03d}-sweep-{n_iter:04d}")
+            if os.path.isdir(last):
+                shutil.rmtree(last, ignore_errors=True)
 
     # -- tuning trials --------------------------------------------------------
 

@@ -343,9 +343,19 @@ class GameEstimator(EventEmitter):
         datasets: Optional[Dict[str, object]] = None,
         combos: Optional[Sequence[Mapping[str, float]]] = None,
         n_cd_iterations: Optional[int] = None,
+        boundary_fn: Optional[object] = None,
+        resume_state: Optional[object] = None,
     ) -> List[GameResult]:
         """``checkpoint_fn(reg_weights, iteration, game_model)`` runs after
         each completed coordinate-descent sweep of each configuration.
+
+        ``boundary_fn(reg_weights, state)`` runs after EVERY coordinate
+        update of every configuration (``state`` is descent's
+        CDBoundaryState) — the fine-grained crash-safety hook
+        (robust.CheckpointManager). ``resume_state`` (a
+        robust.CheckpointSnapshot) resumes the FIRST combo in ``combos``
+        mid-run; callers resuming a grid pass the remaining combos
+        explicitly, snapshot matching the first.
 
         ``datasets``: pre-built datasets from :meth:`prepare_datasets`.
         ``combos``: explicit list of per-coordinate reg-weight dicts to train
@@ -389,7 +399,7 @@ class GameEstimator(EventEmitter):
         import time as _time
 
         self.send_event(TrainingStartEvent(time=_time.time()))
-        for reg_weights in combos:
+        for combo_index, reg_weights in enumerate(combos):
             reg_weights = dict(reg_weights)
             coords = self._make_coordinates(datasets, reg_weights, prev_models)
             cd_ckpt = None
@@ -398,10 +408,17 @@ class GameEstimator(EventEmitter):
                 cd_ckpt = lambda it, models, _w=reg_weights: checkpoint_fn(
                     _w, it, GameModel(models=models, task=task)
                 )
+            cd_boundary = None
+            if boundary_fn is not None:
+                cd_boundary = lambda st, _w=reg_weights: boundary_fn(_w, st)
             cd = CoordinateDescent(
                 coords, n_iterations=n_iterations,
                 validation=validation_ctx, checkpoint_fn=cd_ckpt,
                 validation_frequency=self.validation_frequency,
+                boundary_fn=cd_boundary,
+                # a snapshot describes one in-flight configuration — the
+                # first combo of a resumed call; later combos start fresh
+                resume_state=resume_state if combo_index == 0 else None,
             )
             with timed(f"train config {reg_weights}", logging.INFO):
                 out = cd.run(initial_models=prev_models)

@@ -22,7 +22,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from .. import obs
-from ..analysis.runtime import logged_fetch, transfer_guard
+from ..analysis.runtime import allow_transfers, logged_fetch, transfer_guard
 from ..evaluation.suite import EvaluationResults, EvaluationSuite
 from ..models.game import GameModel
 from ..optimize.trackers import build_tracker, record_tracker_metrics
@@ -39,6 +39,27 @@ class CoordinateDescentResult:
     best_evaluation: Optional[EvaluationResults]
     # coordinate -> Fixed/RandomEffectOptimizationTracker (raw SolverResult on
     # tracker.result)
+    trackers: Dict[str, object]
+
+
+@dataclasses.dataclass
+class CDBoundaryState:
+    """Everything the outer loop knows at a coordinate-update boundary — the
+    unit a crash-safe checkpoint persists (robust.checkpoint) and a resumed
+    run restores. Between coordinate updates the entire algorithm state is
+    these few values; mid-update there is no consistent host-visible state,
+    which is why boundaries are the only snapshot points."""
+
+    iteration: int  # sweep index of the update just finished
+    coordinate_index: int  # position in ``coordinate_order`` just finished
+    coordinate: str
+    coordinate_order: List[str]
+    n_iterations: int
+    models: Dict[str, object]
+    summed_scores: jnp.ndarray
+    best_eval: Optional[EvaluationResults]
+    best_models: Dict[str, object]
+    evaluations: List[Tuple[str, EvaluationResults]]
     trackers: Dict[str, object]
 
 
@@ -61,11 +82,30 @@ class CoordinateDescent:
         validation: Optional[ValidationContext] = None,
         checkpoint_fn: Optional[object] = None,
         validation_frequency: str = "COORDINATE",
+        boundary_fn: Optional[object] = None,
+        resume_state: Optional[object] = None,
     ):
         """``checkpoint_fn(iteration, models)`` runs after each completed
         sweep (crash recovery for long runs: resume = warm-start from the
         checkpointed models with the remaining iterations; the score state
         reconstructs exactly from the models).
+
+        ``boundary_fn(state: CDBoundaryState)`` runs after EVERY coordinate
+        update — finer-grained crash recovery than ``checkpoint_fn``
+        (robust.CheckpointManager.on_boundary is the intended callee). It is
+        invoked inside :func:`allow_transfers`, so serializers may fetch
+        device arrays freely; the surrounding sweep stays transfer-guarded.
+
+        ``resume_state``: a restored boundary state (duck type:
+        robust.CheckpointSnapshot — iteration / coordinate_index / models /
+        summed_scores / best_eval / best_models / evaluations). ``run``
+        then continues from the update AFTER the snapshot: per-coordinate
+        scores re-derive from the restored models (deterministic re-score),
+        the summed scores restore exactly from the snapshot, and best-model
+        tracking continues rather than restarting. ``initial_models`` passed
+        to :meth:`run` are ignored on resume — the snapshot already embeds
+        the warm-start lineage. Trackers restart empty (their summaries are
+        checkpointed as strings, not as resumable solver state).
 
         ``validation_frequency``: 'COORDINATE' evaluates after every
         coordinate update (reference semantics, CoordinateDescent.scala:
@@ -90,6 +130,8 @@ class CoordinateDescent:
         self.validation = validation
         self.checkpoint_fn = checkpoint_fn
         self.validation_frequency = validation_frequency
+        self.boundary_fn = boundary_fn
+        self.resume_state = resume_state
         n_trainable = sum(
             0 if isinstance(c, ModelCoordinate) else 1 for c in self.coordinates.values()
         )
@@ -115,19 +157,44 @@ class CoordinateDescent:
         models: Dict[str, object] = {}
         trackers: Dict[str, object] = {}
         scores: Dict[str, jnp.ndarray] = {}
-        # initialize scores from warm-start models where available
-        for name in self.order:
-            if name in initial_models:
-                models[name] = initial_models[name]
-                scores[name] = coords[name].score(initial_models[name])
-        zero = jnp.zeros((n,), jnp.float32)
-        summed = sum(scores.values(), zero)
+        start_it = 0
+        start_idx = 0
+        resume = self.resume_state
+        if resume is not None:
+            # restore the boundary state exactly: models come back verbatim,
+            # per-coordinate scores re-derive from them (deterministic XLA →
+            # bit-identical to what the dead process held), and the summed
+            # scores restore from the snapshot so the incremental arithmetic
+            # (summed - own + new) continues on the same values it would have
+            # had uninterrupted
+            models = dict(resume.models)
+            for name in self.order:
+                if name in models:
+                    scores[name] = coords[name].score(models[name])
+            summed = jnp.asarray(resume.summed_scores)
+            evaluations = list(resume.evaluations)
+            best_eval = resume.best_eval
+            best_models = dict(resume.best_models)
+            start_it = int(resume.iteration)
+            start_idx = int(resume.coordinate_index) + 1
+            if start_idx >= len(self.order):
+                start_it += 1
+                start_idx = 0
+        else:
+            # initialize scores from warm-start models where available
+            for name in self.order:
+                if name in initial_models:
+                    models[name] = initial_models[name]
+                    scores[name] = coords[name].score(initial_models[name])
+            zero = jnp.zeros((n,), jnp.float32)
+            summed = sum(scores.values(), zero)
 
-        evaluations: List[Tuple[str, EvaluationResults]] = []
-        best_eval: Optional[EvaluationResults] = None
-        best_models: Dict[str, object] = dict(models)
+            evaluations = []
+            best_eval = None
+            best_models = dict(models)
 
-        for it in range(self.n_iterations):
+        for it in range(start_it, self.n_iterations):
+            first = start_idx if it == start_it else 0
             with obs.span("cd.sweep", iteration=it):
                 # zero-fetch invariant, runtime-enforced: inside the sweep
                 # every device->host transfer must be an explicit
@@ -136,7 +203,8 @@ class CoordinateDescent:
                 # silently stalling the device pipeline. The static half of
                 # this contract is photon_ml_tpu.analysis rule R1.
                 with transfer_guard():
-                    for name in self.order:
+                    for idx in range(first, len(self.order)):
+                        name = self.order[idx]
                         coordinate = coords[name]
                         own = scores.get(name)
                         residual = summed - own if own is not None else summed
@@ -182,6 +250,28 @@ class CoordinateDescent:
                             ):
                                 best_eval, best_models = self._track_best(
                                     models, evaluations, best_eval, best_models, it, name
+                                )
+                        if self.boundary_fn is not None:
+                            # coordinate-update boundary: the only point where
+                            # the outer-loop state is consistent and host-
+                            # reachable. Serialization fetches device arrays,
+                            # so lift the transfer guard for exactly this call
+                            # — a checkpoint is a deliberate sync point.
+                            with allow_transfers():
+                                self.boundary_fn(
+                                    CDBoundaryState(
+                                        iteration=it,
+                                        coordinate_index=idx,
+                                        coordinate=name,
+                                        coordinate_order=list(self.order),
+                                        n_iterations=self.n_iterations,
+                                        models=dict(models),
+                                        summed_scores=summed,
+                                        best_eval=best_eval,
+                                        best_models=dict(best_models),
+                                        evaluations=list(evaluations),
+                                        trackers=dict(trackers),
+                                    )
                                 )
                     if self.validation is not None and self.validation_frequency == "SWEEP":
                         best_eval, best_models = self._track_best(

@@ -37,6 +37,9 @@ import struct
 import zlib
 from typing import Any, Dict, Iterable, Iterator, List, Optional, Tuple, Union
 
+from ..robust.atomic import atomic_write
+from ..robust.retry import io_call
+
 MAGIC = b"Obj\x01"
 SYNC_SIZE = 16
 _PRIMITIVES = {"null", "boolean", "int", "long", "float", "double", "bytes", "string"}
@@ -545,7 +548,20 @@ def read_avro_file(
     back; blocks wholly outside the window are skipped WITHOUT decompressing
     or decoding (the per-host input split of the multi-process runtime —
     each host pays IO+decode for ~1/P of the data). The file is memory-mapped,
-    so skipped payload pages are never read from disk."""
+    so skipped payload pages are never read from disk.
+
+    Transient IO failures (OSError) retry under the default backoff policy
+    at site ``io.avro_read`` (the reference's Spark task retry)."""
+    return io_call(
+        _read_avro_file, path, reader_schema, row_range, site="io.avro_read"
+    )
+
+
+def _read_avro_file(
+    path: str,
+    reader_schema: Optional[Union[str, Schema]] = None,
+    row_range: Optional[Tuple[int, int]] = None,
+) -> Tuple[Schema, List[dict]]:
     with open(path, "rb") as f:
         try:
             data = mmap.mmap(f.fileno(), 0, access=mmap.ACCESS_READ)
@@ -613,7 +629,12 @@ def read_avro_file(
 
 def count_avro_rows(path: str) -> int:
     """Record count of an Object Container File from block headers alone —
-    no decompression, no record decode."""
+    no decompression, no record decode. Retries transient IO failures at
+    site ``io.avro_read``."""
+    return io_call(_count_avro_rows, path, site="io.avro_read")
+
+
+def _count_avro_rows(path: str) -> int:
     with open(path, "rb") as f:
         try:
             data = mmap.mmap(f.fileno(), 0, access=mmap.ACCESS_READ)
@@ -701,7 +722,10 @@ def write_avro_file(
         out.write(payload)
         out.write(sync)
 
-    with open(path, "wb") as out:
+    # atomic (robust.atomic): a crash mid-write leaves no torn .avro behind —
+    # readers see the old file or the complete new one, never a truncated
+    # container (the reference gets this from the HDFS output committer)
+    with atomic_write(path, "wb") as out:
         out.write(header.getvalue())
         buf = _Writer()
         count = 0

@@ -23,6 +23,9 @@ from typing import Dict, Iterable, Iterator, List, Optional, Tuple
 
 import numpy as np
 
+from ..robust.atomic import atomic_write, atomic_write_json
+from ..robust.retry import io_call
+
 DELIMITER = ""
 INTERCEPT_NAME = "(INTERCEPT)"
 INTERCEPT_TERM = ""
@@ -118,14 +121,19 @@ class IndexMap:
 
     @staticmethod
     def load(path: str) -> "IndexMap":
-        with open(path, "rb") as f:
-            magic = f.read(8)
-            if magic != _MAGIC:
-                raise ValueError(f"{path}: bad index store magic {magic!r}")
-            (n,) = struct.unpack("<q", f.read(8))
-            offsets = np.frombuffer(f.read(_I64 * (n + 1)), dtype=np.int64)
-            indices = np.frombuffer(f.read(_I64 * n), dtype=np.int64)
-            blob = f.read()
+        def _read(path):
+            with open(path, "rb") as f:
+                magic = f.read(8)
+                if magic != _MAGIC:
+                    raise ValueError(f"{path}: bad index store magic {magic!r}")
+                (n,) = struct.unpack("<q", f.read(8))
+                offsets = np.frombuffer(f.read(_I64 * (n + 1)), dtype=np.int64)
+                indices = np.frombuffer(f.read(_I64 * n), dtype=np.int64)
+                return n, offsets, indices, f.read()
+
+        # transient read failures retry (site io.index_map_load); a bad magic
+        # is a ValueError and fails immediately
+        n, offsets, indices, blob = io_call(_read, path, site="io.index_map_load")
         k2i = {
             blob[offsets[k] : offsets[k + 1]].decode("utf-8"): int(indices[k])
             for k in range(n)
@@ -145,16 +153,21 @@ def save_partitioned(index_map: IndexMap, out_dir: str, num_partitions: int, sha
         MmapIndexMap.write(
             mapping.items(), os.path.join(out_dir, f"index-{shard}-{p:05d}.bin")
         )
-    with open(os.path.join(out_dir, f"_index-{shard}-meta.json"), "w") as f:
-        json.dump({"shard": shard, "numPartitions": num_partitions, "size": len(index_map)}, f)
+    atomic_write_json(
+        os.path.join(out_dir, f"_index-{shard}-meta.json"),
+        {"shard": shard, "numPartitions": num_partitions, "size": len(index_map)},
+    )
 
 
 def load_partitioned(out_dir: str, shard: str):
     """Open the partitioned stores as zero-heap mmap views (v2 'PHIDX002'
     layout); v1 'PHIDX001' stores from older runs load into an in-memory
     IndexMap for compatibility."""
-    with open(os.path.join(out_dir, f"_index-{shard}-meta.json")) as f:
-        meta = json.load(f)
+    def _read_meta():
+        with open(os.path.join(out_dir, f"_index-{shard}-meta.json")) as f:
+            return json.load(f)
+
+    meta = io_call(_read_meta, site="io.index_map_load")
     part_paths = [
         os.path.join(out_dir, f"index-{shard}-{p:05d}.bin")
         for p in range(meta["numPartitions"])
@@ -178,7 +191,9 @@ def _write_store(magic: bytes, entries: List[Tuple[bytes, int]], path: str):
     offsets = np.zeros(n + 1, dtype=np.int64)
     np.cumsum([len(k) for k, _ in entries], out=offsets[1:])
     indices = np.asarray([i for _, i in entries], dtype=np.int64)
-    with open(path, "wb") as f:
+    # atomic: a crashed indexing run must not leave a torn store that a later
+    # training run mmaps (robust.atomic — the output-committer property)
+    with atomic_write(path, "wb") as f:
         f.write(magic)
         f.write(struct.pack("<q", n))
         f.write(offsets.tobytes())
@@ -279,9 +294,11 @@ class MmapIndexMap:
     def open(path: str) -> "MmapIndexMap":
         import mmap as _mmap
 
-        f = open(path, "rb")
-        mm = _mmap.mmap(f.fileno(), 0, access=_mmap.ACCESS_READ)
-        f.close()
+        def _map():
+            with open(path, "rb") as f:
+                return _mmap.mmap(f.fileno(), 0, access=_mmap.ACCESS_READ)
+
+        mm = io_call(_map, site="io.index_map_load")
         if mm[:8] != _MAGIC2:
             raise ValueError(f"{path}: bad v2 index store magic {bytes(mm[:8])!r}")
         (n,) = struct.unpack("<q", mm[8:16])

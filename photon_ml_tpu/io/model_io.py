@@ -29,6 +29,8 @@ import numpy as np
 from ..models.coefficients import Coefficients
 from ..models.game import FixedEffectModel, GameModel, RandomEffectModel
 from ..models.glm import GeneralizedLinearModel, model_for_task
+from ..robust.atomic import atomic_write, atomic_write_json
+from ..robust.retry import io_call
 from .avro import iter_avro_directory, write_avro_file
 from .index_map import IndexMap, feature_key, split_feature_key
 from .schemas import BAYESIAN_LINEAR_MODEL_AVRO
@@ -133,15 +135,28 @@ def save_game_model(
 ):
     os.makedirs(model_dir, exist_ok=True)
     meta = {"modelType": game_model.task.upper(), **(metadata or {})}
-    with open(os.path.join(model_dir, "model-metadata.json"), "w") as f:
-        json.dump(meta, f, indent=2)
+    # every file in the layout lands atomically (temp+fsync+rename,
+    # robust.atomic) and retries transient failures at site io.model_save: a
+    # crashed/flaky save never leaves a torn file a later load half-reads
+    io_call(
+        atomic_write_json,
+        os.path.join(model_dir, "model-metadata.json"),
+        meta, indent=2,
+        site="io.model_save",
+    )
+
+    def _write_id_info(path, text):
+        with atomic_write(path, "w") as f:
+            f.write(text)
 
     for name, sub in game_model.models.items():
         if isinstance(sub, FixedEffectModel):
             base = os.path.join(model_dir, "fixed-effect", name)
             os.makedirs(os.path.join(base, "coefficients"), exist_ok=True)
-            with open(os.path.join(base, "id-info"), "w") as f:
-                f.write(sub.feature_shard + "\n")
+            io_call(
+                _write_id_info, os.path.join(base, "id-info"),
+                sub.feature_shard + "\n", site="io.model_save",
+            )
             save_glm(
                 os.path.join(base, "coefficients", "part-00000.avro"),
                 sub.model,
@@ -152,8 +167,11 @@ def save_game_model(
         elif isinstance(sub, RandomEffectModel):
             base = os.path.join(model_dir, "random-effect", name)
             os.makedirs(os.path.join(base, "coefficients"), exist_ok=True)
-            with open(os.path.join(base, "id-info"), "w") as f:
-                f.write(sub.random_effect_type + "\n" + sub.feature_shard + "\n")
+            io_call(
+                _write_id_info, os.path.join(base, "id-info"),
+                sub.random_effect_type + "\n" + sub.feature_shard + "\n",
+                site="io.model_save",
+            )
             imap = index_maps[sub.feature_shard]
             idx = np.asarray(sub.coef_indices)
             val = np.asarray(sub.coef_values)

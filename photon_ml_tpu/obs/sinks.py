@@ -3,10 +3,18 @@
 raises is logged and ignored, never propagated into the training loop.
 
 - ``JsonlSink``: one JSON line per event (span close, metrics flush,
-  estimator lifecycle event), appended and flushed line-by-line so a crash
-  loses at most the line in flight.
+  estimator lifecycle event), appended line-buffered and explicitly flushed
+  per line so a crash loses at most the line in flight; ``fsync=True``
+  additionally fsyncs on every metrics flush (durable at MetricsSnapshot
+  granularity — per-line fsync would throttle span-heavy runs).
 - ``PrometheusSink``: rewrites a text-exposition file atomically on every
-  metrics flush; the file always holds the latest complete snapshot.
+  metrics flush (robust.atomic); the file always holds the latest complete
+  snapshot.
+
+A sink whose write raises counts the event in
+``photon_sink_dropped_events_total{sink=}`` before the error propagates to
+the emitter's swallow layer, so silently-lossy telemetry shows up in the run
+summary instead of nowhere.
 
 Serialization is fetch-free by construction: event payloads are walked
 shallowly (no ``dataclasses.asdict`` recursion, which would deep-copy the
@@ -22,6 +30,7 @@ import os
 import threading
 from typing import Optional
 
+from ..robust.atomic import atomic_write
 from ..utils.events import EventListener
 from .metrics import render_prometheus
 from .run import MetricsSnapshotEvent
@@ -32,13 +41,37 @@ def _json_placeholder(obj) -> str:
     return f"<{type(obj).__name__}>"
 
 
-class JsonlSink(EventListener):
-    """Crash-safe JSONL event/metric writer (append + per-line flush)."""
+def _count_dropped(sink: str) -> None:
+    # lazy import (obs.run imports this module's siblings); never raises —
+    # the original write error is the one the caller should see
+    try:
+        from . import current_run
 
-    def __init__(self, path: str):
+        current_run().registry.counter(
+            "photon_sink_dropped_events_total",
+            "telemetry events a sink failed to write, by sink",
+        ).labels(sink=sink).inc()
+    # photon: ignore[R4] — counting must not mask the original write error,
+    # and routing through obs.swallowed_error here could recurse into the
+    # very registry lookup that failed
+    except Exception:  # pragma: no cover
+        pass
+
+
+class JsonlSink(EventListener):
+    """Crash-safe JSONL event/metric writer (line-buffered append +
+    explicit per-line flush, optional fsync at metrics-flush granularity)."""
+
+    def __init__(self, path: str, fsync: bool = False):
         self.path = path
+        self.fsync = fsync
         self._lock = threading.Lock()
-        self._f: Optional[object] = open(path, "a", encoding="utf-8")
+        # buffering=1: line-buffered, so even a write the explicit flush
+        # below never reaches (e.g. an exception between write and flush)
+        # hits the OS at the newline
+        # photon: ignore[R5] — append-only JSONL stream; atomic rename
+        # semantics would overwrite earlier lines of the same run
+        self._f: Optional[object] = open(path, "a", buffering=1, encoding="utf-8")
 
     def handle(self, event) -> None:
         payload = self._payload(event)
@@ -46,8 +79,14 @@ class JsonlSink(EventListener):
         with self._lock:
             if self._f is None:
                 return
-            self._f.write(line + "\n")
-            self._f.flush()
+            try:
+                self._f.write(line + "\n")
+                self._f.flush()
+                if self.fsync and isinstance(event, MetricsSnapshotEvent):
+                    os.fsync(self._f.fileno())
+            except OSError:
+                _count_dropped("jsonl")
+                raise
 
     @staticmethod
     def _payload(event) -> dict:
@@ -89,10 +128,14 @@ class PrometheusSink(EventListener):
         if not isinstance(event, MetricsSnapshotEvent):
             return
         text = render_prometheus(event.metrics)
-        tmp = self.path + ".tmp"
-        with open(tmp, "w", encoding="utf-8") as f:
-            f.write(text)
-        os.replace(tmp, self.path)
+        try:
+            # temp + fsync + rename (robust.atomic): scrapers never see a
+            # partially-rewritten exposition file
+            with atomic_write(self.path, "w", encoding="utf-8") as f:
+                f.write(text)
+        except OSError:
+            _count_dropped("prometheus")
+            raise
 
     def close(self) -> None:
         pass

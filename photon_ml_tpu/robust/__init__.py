@@ -1,0 +1,64 @@
+"""Fault tolerance for photon-ml-tpu training runs.
+
+The Spark reference inherits crash safety from its platform: RDD lineage
+recomputes lost partitions, the scheduler retries failed tasks, and HDFS
+output committers rename finished work into place. The JAX port runs as one
+process writing ordinary files, so this package rebuilds those three
+guarantees in library form:
+
+- :mod:`robust.atomic` — write-temp + fsync + atomic-rename file creation
+  (the output-committer property: readers never see a torn file);
+- :mod:`robust.retry` — seeded, bounded exponential-backoff retry around IO
+  sites (the task-retry property), observable via
+  ``photon_retry_attempts_total{site=}``;
+- :mod:`robust.checkpoint` — coordinate-update-boundary snapshots of the
+  coordinate-descent outer loop with digest-bearing manifests and
+  keep-last-K rotation (the lineage property: kill the process anywhere and
+  ``--resume`` replays the remaining updates);
+- :mod:`robust.faults` — a deterministic, seeded fault injector (default
+  off, env-activated) that makes the first three testable: injected IO
+  errors exercise the retry budget, simulated kills exercise resume.
+
+``cli.train --checkpoint-dir D --checkpoint-every N`` / ``--resume`` wire
+this end to end.
+"""
+
+from .atomic import (
+    atomic_write,
+    atomic_write_bytes,
+    atomic_write_json,
+    atomic_write_text,
+)
+from .checkpoint import (
+    CheckpointError,
+    CheckpointIncompatibleError,
+    CheckpointManager,
+    CheckpointSnapshot,
+)
+from .faults import (
+    FaultInjector,
+    FaultSpec,
+    InjectedIOError,
+    SimulatedKill,
+    parse_faults,
+)
+from .retry import DEFAULT_IO_POLICY, RetryPolicy, io_call
+
+__all__ = [
+    "CheckpointError",
+    "CheckpointIncompatibleError",
+    "CheckpointManager",
+    "CheckpointSnapshot",
+    "DEFAULT_IO_POLICY",
+    "FaultInjector",
+    "FaultSpec",
+    "InjectedIOError",
+    "RetryPolicy",
+    "SimulatedKill",
+    "atomic_write",
+    "atomic_write_bytes",
+    "atomic_write_json",
+    "atomic_write_text",
+    "io_call",
+    "parse_faults",
+]

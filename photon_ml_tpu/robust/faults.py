@@ -1,0 +1,192 @@
+"""Deterministic fault injection at named sites.
+
+The fault-tolerance claims of this package ("kill at any coordinate-update
+boundary and resume reproduces the run", "transient IO errors succeed within
+the retry budget") are only claims until something can actually produce
+those failures on demand. This module is that something: IO boundaries and
+checkpoint boundaries call :func:`check` with a site name, and an activated
+injector raises either a transient :class:`InjectedIOError` (an ``OSError``
+subclass, so the retry policy classifies it retryable) or a
+:class:`SimulatedKill` (a ``BaseException`` subclass that no ``except
+Exception`` on the way out can accidentally swallow — the closest a test can
+get to ``kill -9`` without leaving the process).
+
+Default-off and cheap when off: :func:`check` is a module-global ``None``
+test, and no site maintains any state until an injector is installed. The
+hot CD loop itself carries NO check calls — sites live at IO and checkpoint
+boundaries only — so the zero-fetch sweep is untouched either way.
+
+Activation:
+
+- programmatic (tests): ``faults.configure("checkpoint.save:io:1x2")``
+- environment (CLI runs): ``PHOTON_FAULTS=<spec>`` with optional
+  ``PHOTON_FAULTS_SEED=<int>``; ``cli.train`` installs it at startup.
+
+Spec grammar (comma-separated clauses)::
+
+    SITE:KIND:WHEN
+    KIND = io | kill
+    WHEN = N      fire on the N-th call to the site (1-based)
+         | NxM    fire on calls N..N+M-1 (M consecutive transient errors)
+         | pF     fire on each call with probability F (seeded, so the
+                  schedule is deterministic for a given seed)
+
+``io.avro_read:io:1x2`` fails the first two Avro reads then lets the third
+succeed; ``cd.boundary:kill:3:`` kills the process at the third
+coordinate-update boundary.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+import random
+import threading
+from typing import Dict, List, Optional
+
+
+class InjectedIOError(OSError):
+    """Transient IO failure raised by the injector (retryable by policy)."""
+
+
+class SimulatedKill(BaseException):
+    """Simulated process kill. Deliberately NOT an ``Exception`` subclass:
+    retry policies, event-emitter swallowing, and broad handlers must all
+    let it through, exactly like a real SIGKILL would not be catchable."""
+
+
+@dataclasses.dataclass(frozen=True)
+class FaultSpec:
+    site: str
+    kind: str  # "io" | "kill"
+    at: int = 1  # first firing call index, 1-based ("NxM" / "N" forms)
+    times: int = 1  # consecutive firings from ``at``
+    prob: Optional[float] = None  # "pF" form: seeded per-call probability
+
+    def __post_init__(self):
+        if self.kind not in ("io", "kill"):
+            raise ValueError(f"fault kind must be io|kill: {self.kind!r}")
+        if self.prob is None and self.at < 1:
+            raise ValueError(f"fault index is 1-based: {self.at}")
+
+
+def parse_faults(spec: str) -> List[FaultSpec]:
+    """Parse the ``PHOTON_FAULTS`` grammar (see module docstring)."""
+    out: List[FaultSpec] = []
+    for clause in spec.split(","):
+        clause = clause.strip()
+        if not clause:
+            continue
+        parts = clause.split(":")
+        if len(parts) != 3:
+            raise ValueError(
+                f"fault clause {clause!r}: expected SITE:KIND:WHEN "
+                "(e.g. io.avro_read:io:1x2)"
+            )
+        site, kind, when = (p.strip() for p in parts)
+        if when.startswith("p"):
+            out.append(FaultSpec(site=site, kind=kind, prob=float(when[1:])))
+        elif "x" in when:
+            at, times = when.split("x", 1)
+            out.append(FaultSpec(site=site, kind=kind, at=int(at), times=int(times)))
+        else:
+            out.append(FaultSpec(site=site, kind=kind, at=int(when)))
+    return out
+
+
+class FaultInjector:
+    """Seeded, deterministic per-site fault schedule."""
+
+    def __init__(self, specs: List[FaultSpec], seed: int = 0):
+        self.specs = list(specs)
+        self.seed = seed
+        self._lock = threading.Lock()
+        self._calls: Dict[str, int] = {}
+        self._rng: Dict[str, random.Random] = {}
+        self._by_site: Dict[str, List[FaultSpec]] = {}
+        for s in self.specs:
+            self._by_site.setdefault(s.site, []).append(s)
+
+    def hit(self, site: str) -> None:
+        """Record one call at ``site``; raise if a spec says this call fails."""
+        specs = self._by_site.get(site)
+        if not specs:
+            return
+        with self._lock:
+            n = self._calls.get(site, 0) + 1
+            self._calls[site] = n
+            fire: Optional[FaultSpec] = None
+            for s in specs:
+                if s.prob is not None:
+                    # one rng per site, seeded by (seed, site): the schedule
+                    # is a pure function of the seed, not of call interleaving
+                    # across sites
+                    rng = self._rng.get(site)
+                    if rng is None:
+                        rng = random.Random(f"{self.seed}:{site}")
+                        self._rng[site] = rng
+                    if rng.random() < s.prob:
+                        fire = s
+                        break
+                elif s.at <= n < s.at + s.times:
+                    fire = s
+                    break
+        if fire is None:
+            return
+        _count_injection(site, fire.kind)
+        if fire.kind == "kill":
+            raise SimulatedKill(f"injected kill at site {site!r} (call {n})")
+        raise InjectedIOError(f"injected IO error at site {site!r} (call {n})")
+
+    def calls(self, site: str) -> int:
+        with self._lock:
+            return self._calls.get(site, 0)
+
+
+def _count_injection(site: str, kind: str) -> None:
+    from .. import obs
+
+    obs.current_run().registry.counter(
+        "photon_faults_injected_total", "faults raised by the injector"
+    ).labels(site=site, kind=kind).inc()
+
+
+# the one module-global the hot path reads; None == disabled
+_injector: Optional[FaultInjector] = None
+
+
+def check(site: str) -> None:
+    """Fault-injection hook: no-op (one ``is None`` test) unless an injector
+    is installed. Call at IO / checkpoint boundaries, never in hot loops."""
+    inj = _injector
+    if inj is not None:
+        inj.hit(site)
+
+
+def active() -> bool:
+    return _injector is not None
+
+
+def configure(spec, seed: int = 0) -> FaultInjector:
+    """Install an injector from a spec string or list of FaultSpecs."""
+    global _injector
+    specs = parse_faults(spec) if isinstance(spec, str) else list(spec)
+    _injector = FaultInjector(specs, seed=seed)
+    return _injector
+
+
+def clear() -> None:
+    global _injector
+    _injector = None
+
+
+def install_from_env(env=os.environ) -> Optional[FaultInjector]:
+    """Install from ``PHOTON_FAULTS`` / ``PHOTON_FAULTS_SEED`` if set; clears
+    any previous injector when the variable is absent (so a resumed CLI run
+    without the env var starts clean)."""
+    spec = env.get("PHOTON_FAULTS", "").strip()
+    if not spec:
+        clear()
+        return None
+    seed = int(env.get("PHOTON_FAULTS_SEED", "0"))
+    return configure(spec, seed=seed)

@@ -33,20 +33,36 @@ class HyperparameterTuner:
         skip: int = 0,
     ) -> List[Observation]:
         """``skip``: candidates already consumed by a previous (checkpointed)
-        run — deterministic tuners burn that many draws so a resumed search
-        continues the original candidate sequence instead of repeating it."""
+        run — the count comes from the checkpoint record (state file or
+        boundary-checkpoint manifest ``tuner_trials``). Deterministic tuners
+        burn that many draws so a resumed search continues the original
+        candidate sequence instead of repeating its prefix; a resumed run
+        with ``skip=k`` followed by ``n-k`` trials therefore evaluates
+        exactly the candidates trials ``k..n-1`` of the uninterrupted run
+        would have."""
         raise NotImplementedError
+
+    @staticmethod
+    def _check_skip(skip: int) -> int:
+        if skip < 0:
+            raise ValueError(
+                f"skip must be >= 0 (got {skip}): it counts tuning trials a "
+                "previous checkpointed run already consumed"
+            )
+        return int(skip)
 
 
 class DummyTuner(HyperparameterTuner):
     """No-op tuner (DummyTuner.scala:39): returns no new observations."""
 
     def search(self, n, dimension, evaluation_function, observations=None, discrete_params=None, seed=0, skip=0):
+        self._check_skip(skip)
         return []
 
 
 class RandomTuner(HyperparameterTuner):
     def search(self, n, dimension, evaluation_function, observations=None, discrete_params=None, seed=0, skip=0):
+        skip = self._check_skip(skip)
         search = RandomSearch(dimension, evaluation_function, discrete_params, seed)
         if skip:
             search.draw_candidates(skip)  # burn the consumed prefix
@@ -55,6 +71,7 @@ class RandomTuner(HyperparameterTuner):
 
 class BayesianTuner(HyperparameterTuner):
     def search(self, n, dimension, evaluation_function, observations=None, discrete_params=None, seed=0, skip=0):
+        self._check_skip(skip)
         # GP candidates condition on the observation set (which includes any
         # replayed trials), so no draws are burned on resume
         return GaussianProcessSearch(

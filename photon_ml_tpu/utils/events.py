@@ -106,7 +106,10 @@ class EventEmitter:
             try:
                 l.handle(event)
             except Exception:
-                _swallowed_error("events.listener_handle")
+                # per-listener-type site: a run summary showing 40 swallowed
+                # JsonlSink errors vs 40 anonymous ones is the difference
+                # between "disk full" and a shrug
+                _swallowed_error(f"events.listener_handle.{type(l).__name__}")
                 logger.exception(
                     "event listener %r failed on %s", l, type(event).__name__
                 )

@@ -17,6 +17,7 @@ from ..io.avro import write_avro_file
 from ..io.data import RawDataset
 from ..io.index_map import IndexMap, split_feature_key
 from ..io.schemas import FEATURE_SUMMARIZATION_RESULT_AVRO
+from ..robust.retry import io_call
 
 
 def compute_feature_statistics(raw: RawDataset, shard: str) -> Dict[str, np.ndarray]:
@@ -92,4 +93,9 @@ def save_feature_statistics(path: str, stats: Dict[str, np.ndarray], index_map: 
                 },
             }
 
-    write_avro_file(path, FEATURE_SUMMARIZATION_RESULT_AVRO, records())
+    # atomic via write_avro_file; transient failures retry (Spark task-retry
+    # parity — a stats write must not kill a run that just finished training)
+    io_call(
+        write_avro_file, path, FEATURE_SUMMARIZATION_RESULT_AVRO, list(records()),
+        site="io.stats_save",
+    )

@@ -285,6 +285,83 @@ def test_r4_narrow_handler_clean():
     assert rules_of(findings(src)) == []
 
 
+# ---------------------------------------------------------------- R5
+
+ATOMIC = "photon_ml_tpu/io/somewriter.py"  # matches default atomic_write
+
+
+R5_SRC = """
+    def f(path, doc):
+        with open(path, "w") as fh:
+            fh.write(doc)
+    """
+
+
+def test_r5_fires_in_atomic_write_module():
+    fs = findings(R5_SRC, ATOMIC)
+    assert rules_of(fs) == ["R5"]
+    assert "atomic_write" in fs[0].message
+
+
+def test_r5_fires_in_robust_package():
+    assert rules_of(findings(R5_SRC, "photon_ml_tpu/robust/newmod.py")) == ["R5"]
+
+
+def test_r5_silent_outside_atomic_modules():
+    assert rules_of(findings(R5_SRC, COLD)) == []
+
+
+def test_r5_read_mode_clean():
+    src = """
+    def f(path):
+        with open(path, "rb") as fh:
+            return fh.read()
+    """
+    assert rules_of(findings(src, ATOMIC)) == []
+
+
+def test_r5_flags_append_exclusive_and_update_modes():
+    src = """
+    def f(path):
+        a = open(path, "ab")
+        b = open(path, mode="x")
+        c = open(path, "r+")
+    """
+    assert rules_of(findings(src, ATOMIC)) == ["R5", "R5", "R5"]
+
+
+def test_r5_nonliteral_mode_flagged():
+    src = """
+    def f(path, mode):
+        return open(path, mode)
+    """
+    fs = findings(src, ATOMIC)
+    assert rules_of(fs) == ["R5"]
+    assert "non-literal mode" in fs[0].message
+
+
+def test_r5_suppressed_inline():
+    src = """
+    def f(path):
+        # photon: ignore[R5] — append-only event log, rename would truncate
+        return open(path, "a")
+    """
+    fs = findings(src, ATOMIC)
+    assert rules_of(fs) == []
+    assert [f.rule for f in fs if f.suppressed] == ["R5"]
+
+
+def test_r5_clean_via_atomic_write():
+    src = """
+    from photon_ml_tpu.robust.atomic import atomic_write
+
+    def f(path, doc):
+        with atomic_write(path, "w") as fh:
+            fh.write(doc)
+    """
+    assert rules_of(findings(src, ATOMIC)) == []
+
+
 # ----------------------------------------------------- suppression mechanics
 
 
