@@ -37,6 +37,16 @@ R5 non-atomic-write: a direct ``open(..., "w"/"a"/"x")`` (or ``io.open``)
    fsync + rename), or carry an explicit ``# photon: ignore[R5]`` stating
    why rename semantics are wrong (e.g. append-only logs).
 
+R6 nan-handling: (a) ``x == nan`` / ``x != nan`` against ``jnp.nan`` /
+   ``np.nan`` / ``math.nan`` anywhere — NaN compares unequal to everything
+   including itself, so the test is constant (use ``jnp.isnan`` /
+   ``np.isnan``); (b) in the hot-loop modules, ``jnp.where(jnp.isnan(...),
+   ...)`` inside a function that increments no obs counter — silently
+   patching NaNs in a hot loop hides numerical divergence from every
+   downstream defense (solver rollback, coordinate rejection). Count the
+   occurrence, or reject via the divergence machinery instead of papering
+   over it.
+
 Taint tracking is deliberately local and conservative: names become
 "jax-typed" through parameter annotations (``Array``, ``jax.Array``, ...)
 and through assignment from expressions rooted at ``jnp.`` / ``jax.`` calls
@@ -59,6 +69,7 @@ RULES: Dict[str, str] = {
     "R3": "dtype discipline (hardcoded itemsize / dtype literal)",
     "R4": "swallowed exception (no re-raise, no obs counter)",
     "R5": "non-atomic file write in an atomic-write module",
+    "R6": "NaN mishandling (== nan compare / uncounted isnan patch)",
 }
 
 # attributes whose value is host metadata, not an array: reading them off a
@@ -759,6 +770,101 @@ def _run_r5(mod: _Module, add: AddFn) -> None:
 
 
 # --------------------------------------------------------------------------
+# R6: NaN mishandling
+
+_NAN_CONSTANTS = {"jax.numpy.nan", "numpy.nan", "math.nan", "numpy.NaN", "numpy.NAN"}
+
+
+def _is_nan_expr(node: ast.AST, aliases: Dict[str, str]) -> bool:
+    d = _canon(_dotted(node), aliases)
+    if d in _NAN_CONSTANTS:
+        return True
+    # float("nan") / float("NaN")
+    if (
+        isinstance(node, ast.Call)
+        and _canon(_dotted(node.func), aliases) == "float"
+        and len(node.args) == 1
+        and isinstance(node.args[0], ast.Constant)
+        and isinstance(node.args[0].value, str)
+        and node.args[0].value.lower() == "nan"
+    ):
+        return True
+    return False
+
+
+def _function_has_counter(fn) -> bool:
+    """Same accounting convention as R4's handler check: a call whose final
+    segment is ``inc`` or ends with ``swallowed_error`` marks the function as
+    making its degraded path visible in metrics."""
+    for node in _own_nodes(fn):
+        if isinstance(node, ast.Call):
+            # attr check, not _dotted: the idiomatic chain is
+            # registry.counter(...).inc(...) whose base is a Call
+            if isinstance(node.func, ast.Attribute) and (
+                node.func.attr == "inc"
+                or node.func.attr.endswith("swallowed_error")
+            ):
+                return True
+            d = _dotted(node.func)
+            if d and d.split(".")[-1].endswith("swallowed_error"):
+                return True
+    return False
+
+
+def _run_r6(mod: _Module, hot: bool, add: AddFn) -> None:
+    aliases = mod.aliases
+    # (a) == / != against a NaN constant: always-constant comparison
+    for node in ast.walk(mod.tree):
+        if not isinstance(node, ast.Compare):
+            continue
+        if not any(isinstance(op, (ast.Eq, ast.NotEq)) for op in node.ops):
+            continue
+        operands = [node.left, *node.comparators]
+        if any(_is_nan_expr(o, aliases) for o in operands):
+            add(
+                node.lineno,
+                node.col_offset,
+                "R6",
+                "comparison against nan is constant (NaN != NaN by IEEE 754): "
+                "== nan is always False, != nan always True; use "
+                "jnp.isnan/np.isnan",
+            )
+    if not hot:
+        return
+    # (b) jnp.where(jnp.isnan(...), ...) in a hot module with no counter in
+    # the enclosing function: the NaN is silently replaced, invisible to the
+    # divergence defenses
+    for fn in mod.walk_functions():
+        counted = None  # lazy: only compute when a candidate where() shows up
+        for node in _own_nodes(fn):
+            if not isinstance(node, ast.Call) or not node.args:
+                continue
+            d = _canon(_dotted(node.func), aliases)
+            if d not in ("jax.numpy.where", "numpy.where"):
+                continue
+            cond_has_isnan = any(
+                isinstance(sub, ast.Call)
+                and _canon(_dotted(sub.func), aliases)
+                in ("jax.numpy.isnan", "numpy.isnan")
+                for sub in ast.walk(node.args[0])
+            )
+            if not cond_has_isnan:
+                continue
+            if counted is None:
+                counted = _function_has_counter(fn)
+            if not counted:
+                add(
+                    node.lineno,
+                    node.col_offset,
+                    "R6",
+                    f"where(isnan(...)) in hot function {fn.name}() silently "
+                    "patches NaNs with no counter: increment an obs counter "
+                    "alongside the patch, or reject the value through the "
+                    "divergence machinery (isfinite + rollback) instead",
+                )
+
+
+# --------------------------------------------------------------------------
 
 
 def run_rules(
@@ -793,5 +899,7 @@ def run_rules(
         _run_r4(mod, adder("R4"))
     if atomic and "R5" in enabled:
         _run_r5(mod, adder("R5"))
+    if "R6" in enabled:
+        _run_r6(mod, hot, adder("R6"))
     out.sort(key=lambda f: (f.line, f.col, f.rule))
     return out

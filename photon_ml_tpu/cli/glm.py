@@ -67,7 +67,13 @@ def build_parser() -> argparse.ArgumentParser:
     )
     p.add_argument(
         "--validate-data", default=VALIDATE_FULL,
-        choices=["VALIDATE_FULL", "VALIDATE_SAMPLE", "DISABLED"],
+        choices=[
+            "VALIDATE_FULL", "VALIDATE_SAMPLE", "VALIDATE_QUARANTINE", "DISABLED",
+        ],
+    )
+    p.add_argument(
+        "--seed", type=int, default=0,
+        help="run seed for seeded subsampling (VALIDATE_SAMPLE row draws)",
     )
     p.add_argument("--variance-type", default="NONE", choices=["NONE", "SIMPLE", "FULL"])
     p.add_argument("--output-dir", required=True)
@@ -101,7 +107,7 @@ def run(argv: Optional[List[str]] = None):
                 response_column=args.response_column,
                 columns=parse_input_columns(args),
             )
-    validate_dataset(raw, args.task, args.validate_data)
+    validate_dataset(raw, args.task, args.validate_data, rng_seed=args.seed)
     stats = compute_feature_statistics(raw, shard)
     stage = "PREPROCESSED"
     logger.info("stage %s: %d rows, %d features", stage, raw.n_rows, raw.shard_dims[shard])

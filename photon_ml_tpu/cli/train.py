@@ -80,6 +80,38 @@ def build_parser() -> argparse.ArgumentParser:
         "metric cost on long sweeps)",
     )
     p.add_argument("--evaluators", default="", help="comma-separated evaluator specs")
+    p.add_argument(
+        "--validate-data",
+        default="disabled",
+        choices=["full", "sample", "quarantine", "disabled"],
+        help="input data validation (DataValidators semantics): 'full' checks "
+        "every row and fails on problems, 'sample' checks ~1%% of rows "
+        "(seeded by --seed), 'quarantine' zero-weights offending rows and "
+        "keeps training (counted in photon_rows_quarantined_total), "
+        "'disabled' skips validation",
+    )
+    p.add_argument(
+        "--seed",
+        type=int,
+        default=0,
+        help="run seed for seeded subsampling (e.g. SAMPLE-mode data "
+        "validation draws the same rows across reruns)",
+    )
+    p.add_argument(
+        "--no-divergence-guard",
+        action="store_true",
+        help="disable the coordinate-descent divergence guard (rejection of "
+        "updates with non-finite scores/loss); restores the strictly "
+        "zero-fetch sweep",
+    )
+    p.add_argument(
+        "--coordinate-rejection-tolerance",
+        type=float,
+        default=None,
+        help="additionally reject a coordinate update whose training loss "
+        "regresses more than this above the coordinate's last accepted "
+        "loss (default: finiteness-only rejection)",
+    )
     p.add_argument("--output-dir", required=True)
     p.add_argument(
         "--output-mode",
@@ -300,6 +332,17 @@ def _run_training(args, run_t, metric_sinks, t_run0) -> Dict:
     )
     if row_range is not None:
         raw.global_row_start = row_range[0]
+    if args.validate_data != "disabled":
+        # validate BEFORE multi-process padding: pad rows are synthetic
+        # zero-weight rows that would dilute the sample and trip nothing
+        from ..io import validators
+
+        mode = {
+            "full": validators.VALIDATE_FULL,
+            "sample": validators.VALIDATE_SAMPLE,
+            "quarantine": validators.VALIDATE_QUARANTINE,
+        }[args.validate_data]
+        validators.validate_dataset(raw, args.task, mode, rng_seed=args.seed)
     if equal_share is not None:
         raw = raw.pad_rows(equal_share)
     logger.info("training rows: %d; shard dims: %s", raw.n_rows, raw.shard_dims)
@@ -381,6 +424,8 @@ def _run_training(args, run_t, metric_sinks, t_run0) -> Dict:
         ],
         mesh=mesh,
         validation_frequency=args.validation_frequency,
+        divergence_guard=not args.no_divergence_guard,
+        rejection_tolerance=args.coordinate_rejection_tolerance,
     )
     for sink in metric_sinks:
         # estimator lifecycle events (TrainingStart/OptimizationLog/Finish)
@@ -595,6 +640,8 @@ def _run_tuning(args, estimator, raw, validation, coords, prior_results,
             partial_retrain_locked=list(estimator.partial_retrain_locked),
             mesh=estimator.mesh,
             validation_frequency=estimator.validation_frequency,
+            divergence_guard=estimator.divergence_guard,
+            rejection_tolerance=estimator.rejection_tolerance,
         )
         r = est.fit(
             raw, validation=validation,

@@ -109,6 +109,8 @@ class GameEstimator(EventEmitter):
         entity_pad_multiple: int = 1,
         mesh=None,
         validation_frequency: str = "COORDINATE",
+        divergence_guard: bool = True,
+        rejection_tolerance: Optional[float] = None,
     ):
         super().__init__()
         if not coordinate_configs:
@@ -124,6 +126,10 @@ class GameEstimator(EventEmitter):
         self.partial_retrain_locked = set(partial_retrain_locked)
         self.mesh = mesh
         self.validation_frequency = validation_frequency
+        # numerical-divergence defense knobs, passed straight through to
+        # CoordinateDescent (see game/descent.py for semantics)
+        self.divergence_guard = divergence_guard
+        self.rejection_tolerance = rejection_tolerance
         if mesh is not None and entity_pad_multiple == 1:
             # entity blocks shard over the data axis: pad to its size
             from ..parallel.mesh import DATA_AXIS
@@ -419,6 +425,8 @@ class GameEstimator(EventEmitter):
                 # a snapshot describes one in-flight configuration — the
                 # first combo of a resumed call; later combos start fresh
                 resume_state=resume_state if combo_index == 0 else None,
+                divergence_guard=self.divergence_guard,
+                rejection_tolerance=self.rejection_tolerance,
             )
             with timed(f"train config {reg_weights}", logging.INFO):
                 out = cd.run(initial_models=prev_models)

@@ -33,6 +33,7 @@ from ..ops.losses import get_loss
 from ..ops.normalization import NormalizationContext
 from ..optimize import OptimizerType, SolverResult, solve_lbfgs, solve_tron
 from ..optimize.common import abs_tolerances
+from ..robust import faults
 from .data import FixedEffectDataset, RandomEffectDataset
 from .problem import GLMOptimizationConfig, GLMProblem
 from .sampling import down_sample
@@ -97,6 +98,13 @@ class FixedEffectCoordinate(Coordinate):
             # runWithSampling (DistributedOptimizationProblem.scala:155-170)
             batch = down_sample(
                 batch, self.task, self.config.down_sampling_rate, self.down_sampling_seed
+            )
+        if faults.active():
+            # fault site solver.value_and_grad: corrupt the effective offsets
+            # feeding this solve. train() runs eagerly at host level, so the
+            # schedule decision never bakes into a compiled function.
+            batch = batch.with_offsets(
+                faults.corrupt("solver.value_and_grad", batch.offsets)
             )
         problem = GLMProblem(
             task=self.task,
@@ -204,6 +212,13 @@ class RandomEffectCoordinate(Coordinate):
             offsets = blocks.offsets + res_blocks.astype(dtype)
         else:
             offsets = blocks.offsets
+        if faults.active():
+            # same fault site as the fixed-effect path; flat index 0 of the
+            # [E, K] offsets is entity 0's first row, so the corruption
+            # deterministically poisons exactly one entity lane. (The
+            # streamed path carries no injection site — its offsets never
+            # materialize whole.)
+            offsets = faults.corrupt("solver.value_and_grad", offsets)
 
         # w0/priors: multi-process passes host numpy (every process holds the
         # full array; jit treats numpy inputs as replicated contributions).

@@ -23,6 +23,7 @@ import numpy as np
 
 from .. import obs
 from ..analysis.runtime import allow_transfers, logged_fetch, transfer_guard
+from ..robust import faults
 from ..evaluation.suite import EvaluationResults, EvaluationSuite
 from ..models.game import GameModel
 from ..optimize.trackers import build_tracker, record_tracker_metrics
@@ -61,6 +62,10 @@ class CDBoundaryState:
     best_models: Dict[str, object]
     evaluations: List[Tuple[str, EvaluationResults]]
     trackers: Dict[str, object]
+    # last ACCEPTED total train loss per coordinate — the divergence guard's
+    # regression baseline; persisted so a resumed run rejects exactly the
+    # updates the uninterrupted run would have rejected
+    train_losses: Dict[str, float] = dataclasses.field(default_factory=dict)
 
 
 @dataclasses.dataclass
@@ -84,6 +89,8 @@ class CoordinateDescent:
         validation_frequency: str = "COORDINATE",
         boundary_fn: Optional[object] = None,
         resume_state: Optional[object] = None,
+        divergence_guard: bool = True,
+        rejection_tolerance: Optional[float] = None,
     ):
         """``checkpoint_fn(iteration, models)`` runs after each completed
         sweep (crash recovery for long runs: resume = warm-start from the
@@ -111,7 +118,17 @@ class CoordinateDescent:
         coordinate update (reference semantics, CoordinateDescent.scala:
         312-333); 'SWEEP' evaluates once per full sweep — same best-model
         tracking at 1/n_coordinates of the metric cost (round-4 verdict
-        item 5: per-update host metrics dominate large sweeps)."""
+        item 5: per-update host metrics dominate large sweeps).
+
+        ``divergence_guard``: reject a coordinate update whose new scores or
+        total train loss are non-finite — the previous (model, scores) stand,
+        ``summed`` is never poisoned, and the sweep continues (counted in
+        ``photon_coordinate_rejections_total{coordinate=}``). Costs one
+        scalar :func:`logged_fetch` per update; False restores the strictly
+        zero-fetch sweep. ``rejection_tolerance``: additionally reject when
+        the update's train loss regresses more than this above the
+        coordinate's last accepted loss (None — the default — disables the
+        regression check; divergence rejection is purely about finiteness)."""
         if not coordinates:
             raise ValueError("CoordinateDescent needs at least one coordinate")
         if n_iterations < 1:
@@ -124,6 +141,10 @@ class CoordinateDescent:
                 f"validation_frequency must be COORDINATE or SWEEP: "
                 f"{validation_frequency!r}"
             )
+        if rejection_tolerance is not None and rejection_tolerance < 0:
+            raise ValueError(
+                f"rejection_tolerance must be >= 0: {rejection_tolerance}"
+            )
         self.coordinates = dict(coordinates)
         self.order = list(coordinates)
         self.n_iterations = n_iterations
@@ -132,6 +153,8 @@ class CoordinateDescent:
         self.validation_frequency = validation_frequency
         self.boundary_fn = boundary_fn
         self.resume_state = resume_state
+        self.divergence_guard = divergence_guard
+        self.rejection_tolerance = rejection_tolerance
         n_trainable = sum(
             0 if isinstance(c, ModelCoordinate) else 1 for c in self.coordinates.values()
         )
@@ -157,6 +180,7 @@ class CoordinateDescent:
         models: Dict[str, object] = {}
         trackers: Dict[str, object] = {}
         scores: Dict[str, jnp.ndarray] = {}
+        train_losses: Dict[str, float] = {}
         start_it = 0
         start_idx = 0
         resume = self.resume_state
@@ -175,6 +199,10 @@ class CoordinateDescent:
             evaluations = list(resume.evaluations)
             best_eval = resume.best_eval
             best_models = dict(resume.best_models)
+            # older snapshots predate the divergence guard's regression
+            # ledger — resume with an empty one (first accepted update of
+            # each coordinate re-seeds it)
+            train_losses = dict(getattr(resume, "train_losses", None) or {})
             start_it = int(resume.iteration)
             start_idx = int(resume.coordinate_index) + 1
             if start_idx >= len(self.order):
@@ -236,21 +264,48 @@ class CoordinateDescent:
                                     record_tracker_metrics(
                                         obs.current_run().registry, name, tracker
                                     )
-                            models[name] = model
 
                             with timed(f"cd iter {it} coordinate {name}: score"):
                                 new_scores = coordinate.score(model)
-                            # summedScores - oldScores + newScores (:441-446)
-                            summed = residual + new_scores
-                            scores[name] = new_scores
-
-                            if (
-                                self.validation is not None
-                                and self.validation_frequency == "COORDINATE"
-                            ):
-                                best_eval, best_models = self._track_best(
-                                    models, evaluations, best_eval, best_models, it, name
+                            if faults.active():
+                                # fault site coordinate.scores: the schedule
+                                # decision is host-side (eager, never traced)
+                                # and the planting is a pure device scatter —
+                                # legal under the sweep's transfer guard
+                                new_scores = faults.corrupt(
+                                    "coordinate.scores", new_scores
                                 )
+                            accepted, train_loss = (
+                                self._guard(
+                                    name, new_scores, solver_result, train_losses
+                                )
+                                if self.divergence_guard
+                                else (True, None)
+                            )
+                            if accepted:
+                                models[name] = model
+                                # summedScores - oldScores + newScores (:441-446)
+                                summed = residual + new_scores
+                                scores[name] = new_scores
+                                if train_loss is not None:
+                                    train_losses[name] = train_loss
+
+                                if (
+                                    self.validation is not None
+                                    and self.validation_frequency == "COORDINATE"
+                                ):
+                                    best_eval, best_models = self._track_best(
+                                        models, evaluations, best_eval, best_models, it, name
+                                    )
+                            else:
+                                # quarantine the update: models / scores /
+                                # summed were never touched, so the sweep
+                                # continues exactly as if this train had not
+                                # happened (a never-yet-trained coordinate
+                                # simply stays untrained until its next turn);
+                                # no re-evaluation either — the GAME model is
+                                # unchanged
+                                self._reject(it, name)
                         if self.boundary_fn is not None:
                             # coordinate-update boundary: the only point where
                             # the outer-loop state is consistent and host-
@@ -271,6 +326,7 @@ class CoordinateDescent:
                                         best_models=dict(best_models),
                                         evaluations=list(evaluations),
                                         trackers=dict(trackers),
+                                        train_losses=dict(train_losses),
                                     )
                                 )
                     if self.validation is not None and self.validation_frequency == "SWEEP":
@@ -293,6 +349,51 @@ class CoordinateDescent:
             evaluations=evaluations,
             best_evaluation=best_eval,
             trackers=trackers,
+        )
+
+    def _guard(self, name, new_scores, solver_result, train_losses):
+        """Decide whether a freshly trained coordinate update is numerically
+        sound: one scalar :func:`logged_fetch` per update (the finiteness
+        flag and total train loss travel in the same fetch).
+
+        Accepts unless (a) any new score is non-finite, (b) the solver's
+        total loss is non-finite (a born-corrupt solve: divergence at
+        initialization leaves no good iterate to roll back to), or (c)
+        ``rejection_tolerance`` is set and the loss regressed beyond it.
+        Returns ``(accepted, train_loss)``; ``train_loss`` is None for
+        locked coordinates (no solver result), which keeps the regression
+        ledger scoped to real solves."""
+        finite_dev = jnp.all(jnp.isfinite(new_scores))
+        if solver_result is None:
+            ok = bool(logged_fetch("cd.update_guard", finite_dev))
+            return ok, None
+        finite_h, loss_h = logged_fetch(
+            "cd.update_guard", (finite_dev, jnp.sum(solver_result.loss))
+        )
+        if not bool(finite_h):
+            return False, None
+        loss = float(loss_h)
+        if not np.isfinite(loss):
+            return False, None
+        prev = train_losses.get(name)
+        tol = self.rejection_tolerance
+        if tol is not None and prev is not None and loss > prev + tol:
+            return False, None
+        return True, loss
+
+    def _reject(self, it: int, name: str) -> None:
+        # cheap host-only registry work, recorded with or without a sink
+        # (same contract as obs.swallowed_error) — rejections must be visible
+        # in run_summary.json even for runs that never attach a listener
+        obs.current_run().registry.counter(
+            "photon_coordinate_rejections_total",
+            "coordinate updates rejected by the divergence guard",
+        ).labels(coordinate=name).inc()
+        logger.warning(
+            "cd iter %d coordinate %s: update REJECTED (non-finite scores/"
+            "loss or objective regression); previous model stands",
+            it,
+            name,
         )
 
     def _track_best(self, models, evaluations, best_eval, best_models, it, name):

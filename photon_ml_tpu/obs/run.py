@@ -149,6 +149,12 @@ def record_solver_metrics(solver: str, result) -> None:
                 "photon_solver_line_search_failures_total",
                 "solves terminated because no improving step was found",
             ).labels(solver=solver).inc(c)
+        elif int(u) == int(ConvergenceReason.NUMERICAL_DIVERGENCE):
+            reg.counter(
+                "photon_solver_diverged_lanes_total",
+                "solver lanes frozen at their last good iterate after a "
+                "non-finite loss/gradient",
+            ).labels(solver=solver).inc(c)
     # final gradient norm per solve: gradient is [d] for a scalar solve and
     # [d, E] (or [d, lanes]) for batched ones — norm over axis 0 covers both
     gn = np.sqrt((grad * grad).sum(axis=0)).ravel()
@@ -173,6 +179,8 @@ def build_run_summary(registry: MetricsRegistry, total_wall_seconds: float) -> d
             coordinates.setdefault(coord, {}).setdefault("convergence_reasons", {})[
                 m["labels"].get("reason", "?")
             ] = int(m["value"])
+        elif m["name"] == "photon_coordinate_rejections_total":
+            coordinates.setdefault(coord, {})["rejections"] = int(m["value"])
     return {
         "total_wall_seconds": float(total_wall_seconds),
         "coordinates": coordinates,

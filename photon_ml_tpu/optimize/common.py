@@ -41,6 +41,12 @@ class ConvergenceReason(enum.IntEnum):
     OBJECTIVE_NOT_IMPROVING = 2
     FUNCTION_VALUES_CONVERGED = 3
     GRADIENT_CONVERGED = 4
+    # Not in the reference enum: the lane's objective or gradient went
+    # non-finite. The solvers roll the lane back to its last good iterate and
+    # freeze it — without this, NaN comparisons (all False) sail straight
+    # through every tolerance test below and the lane exits with a spurious
+    # OBJECTIVE_NOT_IMPROVING after burning its whole line-search budget.
+    NUMERICAL_DIVERGENCE = 5
 
 
 class OptimizerType(str, enum.Enum):
@@ -117,8 +123,15 @@ def check_convergence(
     loss_abs_tol: Array,
     grad_abs_tol: Array,
     objective_not_improving: Array,
+    diverged: Optional[Array] = None,
 ) -> Array:
-    """Reason code in the reference's precedence order (Optimizer.scala:126-139)."""
+    """Reason code in the reference's precedence order (Optimizer.scala:126-139).
+
+    ``diverged`` (per-lane bool) takes precedence over every other reason:
+    a non-finite loss/gradient fails the tolerance comparisons silently (NaN
+    compares are all False), so without the explicit flag a diverged lane
+    would fall through to OBJECTIVE_NOT_IMPROVING or MAX_ITERATIONS.
+    """
     reason = jnp.where(
         grad_norm <= grad_abs_tol, ConvergenceReason.GRADIENT_CONVERGED, 0
     )
@@ -131,7 +144,18 @@ def check_convergence(
         objective_not_improving, ConvergenceReason.OBJECTIVE_NOT_IMPROVING, reason
     )
     reason = jnp.where(it >= max_iterations, ConvergenceReason.MAX_ITERATIONS, reason)
+    if diverged is not None:
+        reason = jnp.where(
+            diverged, ConvergenceReason.NUMERICAL_DIVERGENCE, reason
+        )
     return reason.astype(jnp.int32)
+
+
+def finite_state(f: Array, g: Array) -> Array:
+    """Per-lane "this (loss, gradient) pair is numerically sound": scalar for
+    1-D gradients, [E] for entity-minor stacks [d, E] (axis-0 reduction like
+    :func:`_norm`)."""
+    return jnp.isfinite(f) & jnp.all(jnp.isfinite(g), axis=0)
 
 
 def as_partial(fn):

@@ -56,6 +56,7 @@ from .common import (
     _vdot,
     as_partial,
     check_convergence,
+    finite_state,
 )
 
 Array = jax.Array
@@ -347,14 +348,22 @@ def _solve(
 
     pg0 = effective_grad(w0, g0)
 
+    # a lane whose data is already corrupt has no good iterate to roll back
+    # to: freeze it at w0 immediately instead of letting NaN flow through the
+    # two-loop recursion (every comparison against NaN is False, so nothing
+    # downstream would ever catch it)
+    bad0 = ~finite_state(f0, g0) & jnp.ones(lanes, bool)
+
     init = _LBFGSState(
         w=w0,
         f=f0,
         g=g0,
         it=jnp.zeros(lanes, jnp.int32),
         k=jnp.asarray(0, jnp.int32),
-        done=jnp.zeros(lanes, bool),
-        reason=jnp.zeros(lanes, jnp.int32),
+        done=bad0,
+        reason=jnp.where(
+            bad0, int(ConvergenceReason.NUMERICAL_DIVERGENCE), 0
+        ).astype(jnp.int32),
         S=jnp.zeros((m,) + w0.shape, dtype),
         Y=jnp.zeros((m,) + w0.shape, dtype),
         rho=jnp.zeros((m,) + lanes, dtype),
@@ -388,7 +397,12 @@ def _solve(
             max_line_search_iterations, box=box, g_plain=s.g,
         )
 
-        improved = ls_ok & (f_new < s.f)
+        # a non-finite trial outcome is numerical divergence: the masked
+        # commit below keeps the last good iterate (rollback is free), and
+        # excluding the lane from `improved` refuses the corrupted (s, y)
+        # correction pair
+        finite_new = finite_state(f_new, g_new)
+        improved = ls_ok & (f_new < s.f) & finite_new
 
         # history update (only when improved)
         s_vec = w_new - s.w
@@ -432,6 +446,7 @@ def _solve(
             loss_abs_tol,
             grad_abs_tol,
             objective_not_improving=~improved,
+            diverged=~finite_new,
         )
         newly_done = reason != 0
 

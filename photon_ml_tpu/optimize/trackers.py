@@ -8,6 +8,11 @@ advance in LOCKSTEP through one vmapped solver — wall-clock is a property of
 the whole block, which the Timed sections already record), and
 CoordinateDescent.logOptimizationSummary (photon-lib
 .../algorithm/CoordinateDescent.scala:230-248).
+
+The reason histogram is enum-driven (``ConvergenceReason(int(u)).name``), so
+lanes frozen by the divergence defense show up as NUMERICAL_DIVERGENCE rows
+here with no tracker-side changes; ``obs.record_solver_metrics`` additionally
+routes that reason into ``photon_solver_diverged_lanes_total``.
 """
 
 from __future__ import annotations

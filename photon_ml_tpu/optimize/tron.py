@@ -29,6 +29,7 @@ import jax.numpy as jnp
 
 from .. import obs
 from .common import (
+    ConvergenceReason,
     HvpFn,
     SolverResult,
     ValueAndGradFn,
@@ -36,6 +37,7 @@ from .common import (
     _vdot,
     as_partial,
     check_convergence,
+    finite_state,
     project_box,
 )
 
@@ -169,6 +171,11 @@ def _solve(
     lanes = jnp.shape(f0)  # () single problem / [E] entity-minor batch
     hist = jnp.full((max_iterations + 1,) + lanes, jnp.nan, dtype)
 
+    # corrupt-at-start lane: no good iterate exists, freeze at w0 (same
+    # defense as lbfgs._solve — NaN comparisons are all False, so nothing
+    # below would ever terminate the lane for the right reason)
+    bad0 = ~finite_state(f0, g0) & jnp.ones(lanes, bool)
+
     init = _TronState(
         w=w0,
         f=f0,
@@ -176,8 +183,10 @@ def _solve(
         delta=_norm(g0),
         it=jnp.zeros(lanes, jnp.int32),
         failures=jnp.zeros(lanes, jnp.int32),
-        done=jnp.zeros(lanes, bool),
-        reason=jnp.zeros(lanes, jnp.int32),
+        done=bad0,
+        reason=jnp.where(
+            bad0, int(ConvergenceReason.NUMERICAL_DIVERGENCE), 0
+        ).astype(jnp.int32),
         loss_history=hist.at[0].set(f0),
         grad_norm_history=hist.at[0].set(_norm(g0)),
     )
@@ -219,7 +228,13 @@ def _solve(
             ),
         )
 
-        accepted = actual > _ETA0 * predicted
+        # a non-finite trial is numerical divergence: never accept it (the
+        # masked commit keeps the last good iterate), and keep the NaN out of
+        # delta — alpha above is computed from f_try, so without this guard a
+        # single NaN trial poisons the trust-region radius of the lane forever
+        finite_try = finite_state(f_try, g_try)
+        accepted = (actual > _ETA0 * predicted) & finite_try
+        delta_new = jnp.where(finite_try, delta_new, s.delta)
         w_acc = project_box(w_try, box) if box is not None else w_try
         w_new = jnp.where(accepted, w_acc, s.w)
         f_new = jnp.where(accepted, f_try, s.f)
@@ -237,9 +252,13 @@ def _solve(
             loss_abs_tol,
             grad_abs_tol,
             objective_not_improving=too_many_failures,
+            diverged=~finite_try,
         )
-        # a rejected trial alone isn't convergence; only repeated failure is
-        reason = jnp.where(accepted | too_many_failures, reason, 0).astype(jnp.int32)
+        # a rejected trial alone isn't convergence; only repeated failure
+        # (or divergence, which freezes the rolled-back lane) is
+        reason = jnp.where(
+            accepted | too_many_failures | ~finite_try, reason, 0
+        ).astype(jnp.int32)
         newly_done = reason != 0
 
         keep = s.done

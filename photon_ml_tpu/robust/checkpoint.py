@@ -79,6 +79,9 @@ class CheckpointSnapshot:
     tracker_summaries: Dict[str, str]
     manifest: dict
     path: str
+    # divergence-guard regression baselines (PR 4); defaulted so snapshots
+    # written before the field existed still restore
+    train_losses: Dict[str, float] = dataclasses.field(default_factory=dict)
 
 
 def _registry():
@@ -152,6 +155,10 @@ class CheckpointManager:
             "evaluations": list(state.evaluations),
             "tracker_summaries": {
                 name: t.to_summary_string() for name, t in state.trackers.items()
+            },
+            "train_losses": {
+                k: float(v)
+                for k, v in (getattr(state, "train_losses", None) or {}).items()
             },
         }
         blob = pickle.dumps(payload, protocol=pickle.HIGHEST_PROTOCOL)
@@ -283,6 +290,7 @@ class CheckpointManager:
                 tracker_summaries=payload["tracker_summaries"],
                 manifest=manifest,
                 path=ckpt_dir,
+                train_losses=payload.get("train_losses", {}),
             )
         return None
 

@@ -25,7 +25,7 @@ Activation:
 Spec grammar (comma-separated clauses)::
 
     SITE:KIND:WHEN
-    KIND = io | kill
+    KIND = io | kill | nan
     WHEN = N      fire on the N-th call to the site (1-based)
          | NxM    fire on calls N..N+M-1 (M consecutive transient errors)
          | pF     fire on each call with probability F (seeded, so the
@@ -34,6 +34,16 @@ Spec grammar (comma-separated clauses)::
 ``io.avro_read:io:1x2`` fails the first two Avro reads then lets the third
 succeed; ``cd.boundary:kill:3:`` kills the process at the third
 coordinate-update boundary.
+
+The ``nan`` kind never raises: it acts through :func:`corrupt`, which sites
+holding concrete arrays call as ``tree = faults.corrupt(site, tree)``. When
+the schedule fires, NaN is planted at flat index 0 of every floating-point
+leaf (deterministic — the same spec corrupts the same element every run),
+exercising the numerical-divergence defenses (solver rollback, coordinate
+rejection) without contriving pathological input data.
+``solver.value_and_grad:nan:3`` corrupts the effective offsets of the third
+host-level coordinate solve; ``coordinate.scores:nan:p0.3`` corrupts each
+coordinate's freshly computed scores with probability 0.3.
 """
 
 from __future__ import annotations
@@ -58,14 +68,14 @@ class SimulatedKill(BaseException):
 @dataclasses.dataclass(frozen=True)
 class FaultSpec:
     site: str
-    kind: str  # "io" | "kill"
+    kind: str  # "io" | "kill" | "nan"
     at: int = 1  # first firing call index, 1-based ("NxM" / "N" forms)
     times: int = 1  # consecutive firings from ``at``
     prob: Optional[float] = None  # "pF" form: seeded per-call probability
 
     def __post_init__(self):
-        if self.kind not in ("io", "kill"):
-            raise ValueError(f"fault kind must be io|kill: {self.kind!r}")
+        if self.kind not in ("io", "kill", "nan"):
+            raise ValueError(f"fault kind must be io|kill|nan: {self.kind!r}")
         if self.prob is None and self.at < 1:
             raise ValueError(f"fault index is 1-based: {self.at}")
 
@@ -107,15 +117,14 @@ class FaultInjector:
         for s in self.specs:
             self._by_site.setdefault(s.site, []).append(s)
 
-    def hit(self, site: str) -> None:
-        """Record one call at ``site``; raise if a spec says this call fails."""
+    def _schedule(self, site: str):
+        """Count one call at ``site``; return (firing spec or None, call n)."""
         specs = self._by_site.get(site)
         if not specs:
-            return
+            return None, 0
         with self._lock:
             n = self._calls.get(site, 0) + 1
             self._calls[site] = n
-            fire: Optional[FaultSpec] = None
             for s in specs:
                 if s.prob is not None:
                     # one rng per site, seeded by (seed, site): the schedule
@@ -126,17 +135,37 @@ class FaultInjector:
                         rng = random.Random(f"{self.seed}:{site}")
                         self._rng[site] = rng
                     if rng.random() < s.prob:
-                        fire = s
-                        break
+                        return s, n
                 elif s.at <= n < s.at + s.times:
-                    fire = s
-                    break
-        if fire is None:
-            return
+                    return s, n
+        return None, n
+
+    def _raise(self, fire: FaultSpec, site: str, n: int) -> None:
         _count_injection(site, fire.kind)
         if fire.kind == "kill":
             raise SimulatedKill(f"injected kill at site {site!r} (call {n})")
         raise InjectedIOError(f"injected IO error at site {site!r} (call {n})")
+
+    def hit(self, site: str) -> None:
+        """Record one call at ``site``; raise if a spec says this call fails.
+        ``nan`` specs never fire here — a check-only site holds no arrays to
+        corrupt; they act through :meth:`corrupt`."""
+        fire, n = self._schedule(site)
+        if fire is None or fire.kind == "nan":
+            return
+        self._raise(fire, site, n)
+
+    def corrupt(self, site: str, tree):
+        """Record one call at ``site``; return ``tree`` with NaN planted into
+        its floating-point array leaves when a ``nan`` spec fires (io/kill
+        specs at a corrupt site raise exactly as :meth:`hit` would)."""
+        fire, n = self._schedule(site)
+        if fire is None:
+            return tree
+        if fire.kind != "nan":
+            self._raise(fire, site, n)
+        _count_injection(site, "nan")
+        return _plant_nan(tree)
 
     def calls(self, site: str) -> int:
         with self._lock:
@@ -151,6 +180,35 @@ def _count_injection(site: str, kind: str) -> None:
     ).labels(site=site, kind=kind).inc()
 
 
+def _plant_nan(tree):
+    """NaN planted at flat index 0 of every floating-point array leaf —
+    deterministic, so a given spec corrupts the same element on every run.
+    Non-float and empty leaves pass through untouched. Device arrays are
+    corrupted ON DEVICE (a pure scatter, legal under the sweep's transfer
+    guard); host numpy leaves are copied, never mutated in place."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    def plant(leaf):
+        if isinstance(leaf, np.ndarray):
+            if leaf.size and np.issubdtype(leaf.dtype, np.floating):
+                out = leaf.copy()
+                out.ravel()[0] = np.nan
+                return out
+            return leaf
+        if (
+            isinstance(leaf, jax.Array)
+            and leaf.size
+            and jnp.issubdtype(leaf.dtype, jnp.floating)
+        ):
+            flat = jnp.reshape(leaf, (-1,)).at[0].set(jnp.nan)
+            return jnp.reshape(flat, leaf.shape)
+        return leaf
+
+    return jax.tree_util.tree_map(plant, tree)
+
+
 # the one module-global the hot path reads; None == disabled
 _injector: Optional[FaultInjector] = None
 
@@ -161,6 +219,18 @@ def check(site: str) -> None:
     inj = _injector
     if inj is not None:
         inj.hit(site)
+
+
+def corrupt(site: str, tree):
+    """NaN-injection hook for sites holding concrete arrays: pass-through
+    (one ``is None`` test) unless an injector with a ``nan`` spec for this
+    site decides the call fires. Call where arrays are HOST-CONCRETE — never
+    under a jit trace, where the host-side schedule decision would bake into
+    the compiled function."""
+    inj = _injector
+    if inj is None:
+        return tree
+    return inj.corrupt(site, tree)
 
 
 def active() -> bool:

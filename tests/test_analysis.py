@@ -362,6 +362,99 @@ def test_r5_clean_via_atomic_write():
     assert rules_of(findings(src, ATOMIC)) == []
 
 
+# ---------------------------------------------------------------- R6
+
+
+def test_r6_nan_compare_fires_anywhere():
+    src = """
+    import jax.numpy as jnp
+    import numpy as np
+    import math
+
+    def f(x):
+        if x == jnp.nan:
+            return 0
+        if x != np.nan:
+            return 1
+        if x == math.nan:
+            return 2
+        if x == float("nan"):
+            return 3
+    """
+    fs = findings(src, COLD)  # unconditional: fires outside hot modules too
+    assert rules_of(fs) == ["R6"] * 4
+    assert "IEEE 754" in fs[0].message
+
+
+def test_r6_ordinary_compares_clean():
+    src = """
+    import jax.numpy as jnp
+
+    def f(x, nan_count):
+        if nan_count == 0 and x != 1.0:
+            return jnp.isnan(x)
+    """
+    assert rules_of(findings(src, COLD)) == []
+
+
+def test_r6_uncounted_isnan_patch_fires_in_hot_module():
+    src = """
+    import jax.numpy as jnp
+
+    def patch(x):
+        return jnp.where(jnp.isnan(x), 0.0, x)
+    """
+    fs = findings(src, HOT)
+    assert rules_of(fs) == ["R6"]
+    assert "counter" in fs[0].message
+
+
+def test_r6_isnan_patch_silent_outside_hot_modules():
+    src = """
+    import jax.numpy as jnp
+
+    def patch(x):
+        return jnp.where(jnp.isnan(x), 0.0, x)
+    """
+    assert rules_of(findings(src, COLD)) == []
+
+
+def test_r6_counted_isnan_patch_clean():
+    src = """
+    import jax.numpy as jnp
+    from photon_ml_tpu import obs
+
+    def patch(x):
+        y = jnp.where(jnp.isnan(x), 0.0, x)
+        obs.current_run().registry.counter("c", "h").inc()
+        return y
+    """
+    assert rules_of(findings(src, HOT)) == []
+
+
+def test_r6_isfinite_where_clean():
+    src = """
+    import jax.numpy as jnp
+
+    def commit(x, new):
+        return jnp.where(jnp.isfinite(new), new, x)
+    """
+    assert rules_of(findings(src, HOT)) == []
+
+
+def test_r6_suppressed_inline():
+    src = """
+    import jax.numpy as jnp
+
+    def patch(x):
+        # photon: ignore[R6] — display-only path, NaNs already counted upstream
+        return jnp.where(jnp.isnan(x), 0.0, x)
+    """
+    fs = findings(src, HOT)
+    assert rules_of(fs) == []
+    assert [f.rule for f in fs if f.suppressed] == ["R6"]
+
+
 # ----------------------------------------------------- suppression mechanics
 
 

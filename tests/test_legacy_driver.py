@@ -136,3 +136,104 @@ def test_validators_poisson_negative_labels():
     )
     with pytest.raises(DataValidationError, match="negative labels"):
         validate_dataset(raw, "poisson_regression")
+
+
+def _raw(n=6, bad_offsets=(), bad_labels=(), bad_values=(), bad_weights=(),
+         oob_cols=()):
+    """A small linear-regression dataset with one feature value per row, with
+    selected rows corrupted."""
+    labels = np.linspace(-1.0, 1.0, n)
+    offsets = np.zeros(n)
+    weights = np.ones(n)
+    rows = np.arange(n)
+    cols = np.zeros(n, dtype=np.int64)
+    vals = np.ones(n)
+    labels[list(bad_labels)] = np.nan
+    offsets[list(bad_offsets)] = np.inf
+    weights[list(bad_weights)] = -1.0
+    vals[list(bad_values)] = np.nan
+    cols[list(oob_cols)] = 7
+    return RawDataset(
+        n_rows=n,
+        labels=labels,
+        offsets=offsets,
+        weights=weights,
+        shard_coo={"global": (rows, cols, vals)},
+        shard_dims={"global": 2},
+        id_tags={},
+    )
+
+
+def test_validators_report_offending_row_counts():
+    raw = _raw(bad_offsets=(0, 3), bad_values=(2,))
+    with pytest.raises(
+        DataValidationError,
+        match=r"2 non-finite offsets.*1 non-finite feature values across 1 rows",
+    ):
+        validate_dataset(raw, "linear_regression")
+
+
+def test_validate_sample_threads_seed():
+    """SAMPLE mode draws rows from the run seed, not a hardcoded one: a seed
+    whose draw covers the bad row fails, a seed whose draw misses it passes."""
+    from photon_ml_tpu.io.validators import VALIDATE_SAMPLE, _sample
+
+    n, bad_row = 300, 17
+    raw = _raw(n=n, bad_labels=(bad_row,))
+    catching = missing = None
+    for seed in range(200):
+        hit = bad_row in _sample(n, VALIDATE_SAMPLE, seed)
+        if hit and catching is None:
+            catching = seed
+        if not hit and missing is None:
+            missing = seed
+        if catching is not None and missing is not None:
+            break
+    assert catching is not None and missing is not None
+    with pytest.raises(DataValidationError, match="non-finite labels"):
+        validate_dataset(raw, "linear_regression", VALIDATE_SAMPLE,
+                        rng_seed=catching)
+    validate_dataset(raw, "linear_regression", VALIDATE_SAMPLE,
+                     rng_seed=missing)
+
+
+def test_quarantine_zero_weights_and_sanitizes():
+    from photon_ml_tpu import obs
+    from photon_ml_tpu.io.validators import VALIDATE_QUARANTINE
+
+    raw = _raw(bad_labels=(0,), bad_offsets=(1,), bad_values=(2,),
+               bad_weights=(3,))
+    r = obs.RunTelemetry()
+    with obs.use_run(r):
+        count = validate_dataset(raw, "linear_regression", VALIDATE_QUARANTINE)
+    assert count == 4
+    assert np.all(raw.weights[:4] == 0.0) and np.all(raw.weights[4:] == 1.0)
+    # quarantined rows are numerically INERT, not just weightless
+    # (0 * NaN == NaN in a weighted loss)
+    assert np.isfinite(raw.labels).all()
+    assert np.isfinite(raw.offsets).all()
+    assert np.isfinite(raw.shard_coo["global"][2]).all()
+    assert (
+        r.registry.counter("photon_rows_quarantined_total", "").labels().value
+        == 4
+    )
+    # clean rows untouched
+    np.testing.assert_array_equal(raw.labels[4:],
+                                  np.linspace(-1.0, 1.0, 6)[4:])
+
+
+def test_quarantine_rejects_all_bad_and_index_corruption():
+    from photon_ml_tpu.io.validators import VALIDATE_QUARANTINE
+
+    all_bad = _raw(n=3, bad_labels=(0, 1, 2))
+    with pytest.raises(DataValidationError, match="nothing left"):
+        validate_dataset(all_bad, "linear_regression", VALIDATE_QUARANTINE)
+
+    oob = _raw(oob_cols=(1,))
+    with pytest.raises(DataValidationError, match="cannot repair"):
+        validate_dataset(oob, "linear_regression", VALIDATE_QUARANTINE)
+
+
+def test_validators_unknown_mode_raises():
+    with pytest.raises(ValueError, match="validation mode"):
+        validate_dataset(_raw(), "linear_regression", "SOMETIMES")

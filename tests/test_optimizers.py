@@ -270,6 +270,116 @@ def test_batched_vmap_tron(rng):
         )
 
 
+def _poisoned_quadratic(b, poison_after_move=True):
+    """Convex quadratic 0.5 w'w - b'w whose objective/gradient turn NaN the
+    moment w leaves the origin (poison_after_move) or unconditionally."""
+    bj = jnp.asarray(b)
+
+    def vg(w):
+        f = 0.5 * jnp.vdot(w, w) - jnp.vdot(bj, w)
+        g = w - bj
+        bad = jnp.any(w != 0.0) if poison_after_move else jnp.asarray(True)
+        poison = jnp.where(bad, jnp.nan, 0.0)
+        return f + poison, g + poison
+
+    return vg
+
+
+@pytest.mark.parametrize("solver", ["lbfgs", "tron"])
+def test_nan_objective_at_first_step_is_numerical_divergence(solver):
+    """NaN loss at t=1 must land on NUMERICAL_DIVERGENCE — every tolerance
+    comparison against NaN is False, so without the explicit finiteness check
+    the solver would grind to max_iterations (or worse, commit the NaN
+    iterate and report a spurious convergence reason). The lane rolls back:
+    coefficients stay at the last finite iterate (w0) and the reported loss
+    is the finite f(w0)."""
+    b = np.asarray([1.0, -2.0, 3.0])
+    vg = _poisoned_quadratic(b)
+    w0 = jnp.zeros(3, jnp.float64)
+    if solver == "lbfgs":
+        res = solve_lbfgs(vg, w0, jnp.asarray(1e-12), jnp.asarray(1e-10), max_iterations=50)
+    else:
+        hvp = lambda w, v: v
+        res = solve_tron(vg, hvp, w0, jnp.asarray(1e-12), jnp.asarray(1e-10), max_iterations=50)
+    assert int(res.reason) == ConvergenceReason.NUMERICAL_DIVERGENCE
+    np.testing.assert_array_equal(np.asarray(res.coefficients), np.zeros(3))
+    assert np.isfinite(float(res.loss))
+    assert int(res.iterations) < 50
+
+
+@pytest.mark.parametrize("solver", ["lbfgs", "tron"])
+def test_nan_objective_at_init_freezes_immediately(solver):
+    """A born-corrupt solve (f0 already NaN) has no good iterate to roll
+    back to: the solver must refuse to move at all and flag divergence."""
+    vg = _poisoned_quadratic(np.ones(3), poison_after_move=False)
+    w0 = jnp.zeros(3, jnp.float64)
+    if solver == "lbfgs":
+        res = solve_lbfgs(vg, w0, jnp.asarray(1e-12), jnp.asarray(1e-10), max_iterations=50)
+    else:
+        hvp = lambda w, v: v
+        res = solve_tron(vg, hvp, w0, jnp.asarray(1e-12), jnp.asarray(1e-10), max_iterations=50)
+    assert int(res.reason) == ConvergenceReason.NUMERICAL_DIVERGENCE
+    assert int(res.iterations) == 0
+    np.testing.assert_array_equal(np.asarray(res.coefficients), np.zeros(3))
+
+
+def test_batched_one_diverged_lane_leaves_neighbors_untouched():
+    """Entity-minor batched mode: poison exactly one lane's objective after
+    its first move. The poisoned lane freezes at w0 with
+    NUMERICAL_DIVERGENCE; every other lane's coefficients are BIT-EXACT
+    against the same batched solve with no poison (masked-commit isolation),
+    and agree with independent unbatched solves of the same problems."""
+    E, d = 5, 3
+    corrupt = 2
+    rng = np.random.default_rng(11)
+    B = rng.normal(size=(d, E))
+    H = rng.uniform(0.5, 2.0, size=(d, E))  # per-lane diagonal Hessians
+    Bj, Hj = jnp.asarray(B), jnp.asarray(H)
+    mask = jnp.asarray(np.arange(E) == corrupt)
+
+    def make_vg(poisoned):
+        def vg(W):  # W: [d, E] entity-minor
+            f = 0.5 * jnp.einsum("de,de->e", W, Hj * W) - jnp.einsum(
+                "de,de->e", Bj, W
+            )
+            g = Hj * W - Bj
+            if not poisoned:
+                return f, g
+            moved = jnp.any(W != 0.0, axis=0)
+            poison = jnp.where(mask & moved, jnp.nan, 0.0)
+            return f + poison, g + poison[None, :]
+
+        return vg
+
+    w0 = jnp.zeros((d, E), jnp.float64)
+    lt, gt = jnp.asarray(1e-12), jnp.asarray(1e-10)
+    res_poisoned = solve_lbfgs(make_vg(True), w0, lt, gt, max_iterations=100, batched=True)
+    res_clean = solve_lbfgs(make_vg(False), w0, lt, gt, max_iterations=100, batched=True)
+
+    reasons = np.asarray(res_poisoned.reason)
+    assert int(reasons[corrupt]) == ConvergenceReason.NUMERICAL_DIVERGENCE
+    coef = np.asarray(res_poisoned.coefficients)
+    np.testing.assert_array_equal(coef[:, corrupt], np.zeros(d))
+    assert np.all(np.isfinite(np.asarray(res_poisoned.loss)))
+
+    healthy = [e for e in range(E) if e != corrupt]
+    # the poisoned lane must not perturb any neighbor by a single ULP
+    np.testing.assert_array_equal(
+        coef[:, healthy], np.asarray(res_clean.coefficients)[:, healthy]
+    )
+    np.testing.assert_array_equal(
+        np.asarray(res_poisoned.loss)[healthy], np.asarray(res_clean.loss)[healthy]
+    )
+    # and each healthy lane solved ITS problem: w* = b / h per diagonal lane
+    for e in healthy:
+        np.testing.assert_allclose(coef[:, e], B[:, e] / H[:, e], atol=1e-8)
+        assert int(reasons[e]) in (
+            ConvergenceReason.FUNCTION_VALUES_CONVERGED,
+            ConvergenceReason.GRADIENT_CONVERGED,
+            ConvergenceReason.OBJECTIVE_NOT_IMPROVING,
+        )
+
+
 def test_convergence_reason_max_iterations(rng):
     x, y, obj = make_logistic(rng, n=80, d=5, l2=0.0)
     cfg = OptimizerConfig(tolerance=1e-16, max_iterations=2)

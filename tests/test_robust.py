@@ -238,6 +238,58 @@ def test_probability_schedule_is_seed_deterministic():
     assert any(schedule(7))
 
 
+def test_parse_faults_nan_kind():
+    specs = parse_faults("solver.value_and_grad:nan:3, coordinate.scores:nan:p0.5")
+    assert specs[0] == FaultSpec(site="solver.value_and_grad", kind="nan", at=3)
+    assert specs[1].kind == "nan" and specs[1].prob == 0.5
+    with pytest.raises(ValueError, match="nan"):
+        parse_faults("a:explode:1")  # error names the valid kinds
+
+
+def test_corrupt_plants_nan_on_exact_call_only(run):
+    faults.configure("s:nan:2")
+    x = np.arange(4.0)
+    assert faults.corrupt("s", x) is x  # call 1: pass-through, same object
+    out = faults.corrupt("s", x)  # call 2: fires
+    assert np.isnan(out[0]) and np.all(out[1:] == x[1:])
+    assert not np.isnan(x).any()  # host array copied, never mutated in place
+    assert faults.corrupt("s", x) is x  # call 3: one-shot spec is spent
+    assert counter_value(
+        run, "photon_faults_injected_total", site="s", kind="nan"
+    ) == 1
+
+
+def test_corrupt_handles_pytrees_and_skips_non_float():
+    faults.configure("s:nan:1")
+    tree = {"f": jnp.ones((2, 3)), "i": np.arange(3), "empty": np.zeros(0)}
+    out = faults.corrupt("s", tree)
+    f = np.asarray(out["f"])
+    assert np.isnan(f.ravel()[0]) and np.isfinite(f.ravel()[1:]).all()
+    assert out["f"].shape == (2, 3)
+    assert out["i"] is tree["i"] and out["empty"] is tree["empty"]
+
+
+def test_nan_spec_never_raises_at_check_sites():
+    faults.configure("s:nan:1")
+    faults.check("s")  # check-only sites hold no arrays: nan must not raise
+    faults.check("s")
+
+
+def test_corrupt_raises_io_and_kill_kinds():
+    faults.configure("s:io:1")
+    with pytest.raises(InjectedIOError):
+        faults.corrupt("s", np.ones(2))
+    faults.configure("s:kill:1")
+    with pytest.raises(SimulatedKill):
+        faults.corrupt("s", np.ones(2))
+
+
+def test_corrupt_passthrough_when_disabled():
+    faults.clear()
+    x = np.ones(3)
+    assert faults.corrupt("anything", x) is x
+
+
 def test_install_from_env_installs_and_clears():
     inj = faults.install_from_env({"PHOTON_FAULTS": "s:io:1", "PHOTON_FAULTS_SEED": "4"})
     assert inj is not None and faults.active() and inj.seed == 4
@@ -547,6 +599,167 @@ def test_training_survives_flaky_checkpoint_io(cd_factory, tmp_path):
     ).run()
     faults.clear()
     assert len(mgr.checkpoints()) == 4
+
+
+# ------------------------------------------------- divergence defense (CD)
+
+
+def test_coordinate_rejection_when_first_update_corrupt(cd_factory, run):
+    """NaN scores on a coordinate's FIRST update (no previous model): the
+    update is rejected, the coordinate simply stays untrained for that turn,
+    the sweep continues, and the coordinate trains cleanly on its next turn."""
+    coords, val = cd_factory()
+    faults.configure("coordinate.scores:nan:1")  # it0 global
+    result = CoordinateDescent(coords, n_iterations=2, validation=val).run()
+    assert (
+        counter_value(
+            run, "photon_coordinate_rejections_total", coordinate="global"
+        )
+        == 1
+    )
+    # both coordinates present and finite in the final model (global trained
+    # on its second turn)
+    for name in coords:
+        s = np.asarray(coords[name].score(result.model[name]))
+        assert np.isfinite(s).all()
+
+
+def test_coordinate_rejection_keeps_previous_model(cd_factory, run):
+    """NaN scores on a LATER update: the previously accepted model and scores
+    stand, bit-for-bit — nothing from the corrupt solve reaches ``summed``."""
+    coords, val = cd_factory()
+    states = []
+    faults.configure("coordinate.scores:nan:3")  # it1 global (call 3)
+    result = CoordinateDescent(
+        coords, n_iterations=2, validation=val, boundary_fn=states.append
+    ).run()
+    assert (
+        counter_value(
+            run, "photon_coordinate_rejections_total", coordinate="global"
+        )
+        == 1
+    )
+    # final global model is the it0 model (boundary state index 0), untouched
+    it0_global = states[0].models["global"]
+    np.testing.assert_array_equal(
+        np.asarray(coords["global"].score(result.model["global"])),
+        np.asarray(coords["global"].score(it0_global)),
+    )
+    for name in coords:
+        assert np.isfinite(
+            np.asarray(coords[name].score(result.model[name]))
+        ).all()
+
+
+def test_solver_nan_injection_diverges_and_rejects(cd_factory, run, tmp_path):
+    """One spec drills both defense levels: corrupting the fixed effect's
+    solver input makes f0 NaN, so the solve freezes at w0 with
+    NUMERICAL_DIVERGENCE (solver level, photon_solver_diverged_lanes_total)
+    and its NaN total loss gets the whole update rejected (coordinate
+    level, photon_coordinate_rejections_total)."""
+    run.register_listener(obs.JsonlSink(str(tmp_path / "m.jsonl")))
+    coords, val = cd_factory()
+    faults.configure("solver.value_and_grad:nan:1")  # it0 global FE solve
+    result = CoordinateDescent(coords, n_iterations=2, validation=val).run()
+    assert (
+        counter_value(
+            run, "photon_coordinate_rejections_total", coordinate="global"
+        )
+        == 1
+    )
+    assert (
+        counter_value(
+            run, "photon_solver_diverged_lanes_total", solver="lbfgs"
+        )
+        >= 1
+    )
+    for name in coords:
+        assert np.isfinite(
+            np.asarray(coords[name].score(result.model[name]))
+        ).all()
+
+
+def test_rejection_tolerance_validation(cd_factory):
+    coords, val = cd_factory()
+    with pytest.raises(ValueError, match="rejection_tolerance"):
+        CoordinateDescent(coords, rejection_tolerance=-0.5)
+
+
+def test_kill_and_resume_across_rejected_boundary(cd_factory, tmp_path, run):
+    """Acceptance: a rejected coordinate update sits between the checkpoint
+    and the kill. The resumed run must make the same accept/reject decisions
+    (the accepted-loss ledger rides in the checkpoint) and reproduce the
+    uninterrupted faulted run's evaluations and final models."""
+    coords, val = cd_factory()
+    faults.configure("coordinate.scores:nan:2")  # it0 per-user rejected
+    ref = CoordinateDescent(coords, n_iterations=2, validation=val).run()
+    faults.clear()
+
+    ckpt_dir = str(tmp_path / "ck")
+    coords2, val2 = cd_factory()
+    mgr = CheckpointManager(ckpt_dir, fsync=False)
+    faults.configure("coordinate.scores:nan:2, cd.boundary_saved:kill:3")
+    with pytest.raises(SimulatedKill):
+        CoordinateDescent(
+            coords2, n_iterations=2, validation=val2, boundary_fn=mgr.on_boundary
+        ).run()
+    faults.clear()
+
+    snap = CheckpointManager(ckpt_dir, fsync=False).latest_valid(
+        expect_coordinate_order=list(coords2), expect_n_iterations=2
+    )
+    assert snap is not None
+    assert (snap.iteration, snap.coordinate_index) == (1, 0)
+    # the rejected per-user update left no model — the snapshot proves the
+    # rejection happened before the kill
+    assert "per-user" not in snap.models or snap.models["per-user"] is not None
+    coords3, val3 = cd_factory()
+    resumed = CoordinateDescent(
+        coords3, n_iterations=2, validation=val3, resume_state=snap
+    ).run()
+    _assert_equivalent(coords, ref, resumed)
+
+
+def test_divergence_guard_off_lets_nan_poison_downstream(cd_factory, run):
+    """--no-divergence-guard semantics: no rejection happens (the zero-fetch
+    sweep is restored) and the corrupt scores flow into the next coordinate's
+    residual, where the solver-level defense catches them as diverged lanes —
+    this documents WHY the coordinate guard defaults on."""
+    from photon_ml_tpu.optimize import ConvergenceReason
+
+    coords, val = cd_factory()
+    faults.configure("coordinate.scores:nan:1")
+    result = CoordinateDescent(
+        coords, n_iterations=1, validation=None, divergence_guard=False
+    ).run()
+    assert (
+        counter_value(
+            run, "photon_coordinate_rejections_total", coordinate="global"
+        )
+        == 0
+    )
+    # the NaN row of the poisoned residual reaches per-user training: the
+    # entity owning that row diverges (and only the solver rollback keeps
+    # its coefficients finite)
+    reasons = np.asarray(result.trackers["per-user"].result.reason)
+    assert (reasons == int(ConvergenceReason.NUMERICAL_DIVERGENCE)).any()
+
+
+@pytest.mark.slow
+def test_nan_storm_still_produces_finite_models(cd_factory, run):
+    """Stress: every instrumented data site corrupts with p=0.3. However the
+    seeded schedule lands, the run must complete and every surviving model
+    must be finite."""
+    coords, val = cd_factory()
+    faults.configure(
+        "solver.value_and_grad:nan:p0.3, coordinate.scores:nan:p0.3", seed=5
+    )
+    result = CoordinateDescent(coords, n_iterations=3, validation=val).run()
+    faults.clear()
+    for name, model in result.model.models.items():
+        assert np.isfinite(np.asarray(coords[name].score(model))).all()
+    if result.best_evaluation is not None:
+        assert np.isfinite(result.best_evaluation.primary_metric)
 
 
 # ---------------------------------------------------------------- tuner resume
