@@ -52,9 +52,13 @@ def parse_coordinate(spec: str) -> CoordinateConfig:
 
     ``feature.dtype=bfloat16``: narrow feature storage (dense/ell/coo fixed
     effects and RE entity blocks; solver state stays wide).
-    ``hbm.budget.mb``: out-of-core random effects — blocks above the budget
-    stay host-resident and stream through the chip in double-buffered
-    slices."""
+    ``hbm.budget.mb``: out-of-core training under an HBM cap. Random
+    effects: entity blocks above the budget stay host-resident and stream
+    through the chip in double-buffered slices (game/streaming.py). Fixed
+    effects: the batch is partitioned into row slices that stream through
+    the chip double-buffered while the solver runs on the host
+    (game/fe_streaming.py; layouts auto|dense|ell, variance NONE only, no
+    down-sampling, not composable with a device mesh)."""
     kv = parse_kv(spec)
     name = kv.pop("name")
     shard = kv.pop("shard")

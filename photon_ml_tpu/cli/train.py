@@ -23,7 +23,6 @@ import json
 import logging
 import os
 import sys
-import threading
 import time
 from typing import Dict, List, Optional
 
@@ -31,7 +30,7 @@ import numpy as np
 
 from .. import obs
 from ..estimators.game_estimator import GameEstimator, GameResult, GameTransformer
-from ..io import read_avro_dataset, save_game_model
+from ..io import read_avro_dataset, read_avro_dataset_chunked, save_game_model
 from ..io.index_map import IndexMap
 from ..io.model_io import load_game_model
 from ..parallel import multihost
@@ -39,6 +38,7 @@ from ..robust import CheckpointManager, atomic_write, atomic_write_json, faults
 from ..ops.normalization import build_normalization
 from ..tuning.rescaling import HyperparameterConfig, ParamRange
 from ..tuning.tuner import get_tuner
+from ..utils.futures import DaemonFuture
 from ..utils.logging import setup_logging
 from ..utils.stats import compute_feature_statistics, save_feature_statistics
 from .params import (
@@ -320,16 +320,32 @@ def _run_training(args, run_t, metric_sinks, t_run0) -> Dict:
             multihost.process_index(), row_range[0], row_range[1], total_rows,
             equal_share,
         )
-    raw, index_maps = read_avro_dataset(
-        input_paths,
-        shards,
-        index_maps=index_maps,
-        id_tag_columns=id_tags,
-        response_column=args.response_column,
-        columns=input_columns,
-        row_range=row_range,
-        part_counts=part_counts,
-    )
+    if multihost.process_count() == 1:
+        # pipelined ingest (io/data.read_avro_dataset_chunked): part k+1
+        # decodes on a daemon thread while part k converts to columnar
+        # arrays and is freed — decode overlaps dataset build instead of
+        # blocking up front, and peak record RSS is ~2 parts, not the input
+        raw, index_maps = read_avro_dataset_chunked(
+            input_paths,
+            shards,
+            index_maps=index_maps,
+            id_tag_columns=id_tags,
+            response_column=args.response_column,
+            columns=input_columns,
+        )
+    else:
+        # multi-process: row-windowed read on the main thread (collective
+        # ordering across hosts must stay deterministic)
+        raw, index_maps = read_avro_dataset(
+            input_paths,
+            shards,
+            index_maps=index_maps,
+            id_tag_columns=id_tags,
+            response_column=args.response_column,
+            columns=input_columns,
+            row_range=row_range,
+            part_counts=part_counts,
+        )
     if row_range is not None:
         raw.global_row_start = row_range[0]
     if args.validate_data != "disabled":
@@ -545,47 +561,9 @@ def _run_training(args, run_t, metric_sinks, t_run0) -> Dict:
     return summary
 
 
-class _DaemonFuture:
-    """Future-shaped handle on a fn run in a DAEMON thread.
-
-    Replaces the ThreadPoolExecutor for the background validation decode:
-    executor threads are non-daemon and concurrent.futures joins them at
-    interpreter exit, so a training crash mid-decode used to block process
-    exit on the full decode. A daemon thread is abandoned at exit — a crash
-    anywhere exits bounded. The flip side: "cancellation" is only ever
-    not-waiting; a decode that already STARTED runs to completion in the
-    background (only not-yet-started work is effectively cancelled — here
-    the thread starts on construction, so a live decode is never killed,
-    merely never joined)."""
-
-    def __init__(self, fn):
-        self._done = threading.Event()
-        self._value = None
-        self._error = None
-
-        def _work():
-            try:
-                self._value = fn()
-            # photon: ignore[R4] — future semantics: stored, re-raised in result()
-            except BaseException as e:
-                self._error = e
-            finally:
-                self._done.set()
-
-        self._thread = threading.Thread(
-            target=_work, name="photon-val-decode", daemon=True
-        )
-        self._thread.start()
-
-    def done(self) -> bool:
-        return self._done.is_set()
-
-    def result(self, timeout=None):
-        if not self._done.wait(timeout):
-            raise TimeoutError("validation decode still running")
-        if self._error is not None:
-            raise self._error
-        return self._value
+# shared with io/data's chunked training-data reader (utils/futures.py);
+# the old name stays as an alias for anything importing it from here
+_DaemonFuture = DaemonFuture
 
 
 def _resolve_validation(validation):

@@ -72,10 +72,13 @@ class CoordinateConfig:
     # incremental training: L2-regularize toward the warm-start model
     # ("Regularize by Previous Model During Warm-Start Training")
     regularize_by_prior: bool = False
-    # out-of-core random effects: when the entity blocks would exceed this
-    # device-memory budget, keep them host-resident and stream double-buffered
-    # entity slices through the chip (game/streaming.py; the reference's
-    # DISK_ONLY spill scale path). RE coordinates only; single-process.
+    # out-of-core coordinates: when the coordinate's device data would exceed
+    # this device-memory budget, keep it host-resident and stream
+    # double-buffered slices through the chip (the reference's DISK_ONLY
+    # spill scale path). Random effects stream entity slices
+    # (game/streaming.py); fixed effects stream row slices
+    # (game/fe_streaming.py — layouts auto|dense|ell, variance NONE, no
+    # down-sampling). Single-process; not composable with a mesh.
     hbm_budget_mb: Optional[int] = None
 
     @property
@@ -149,17 +152,34 @@ class GameEstimator(EventEmitter):
                     "with layout='tiled'"
                 )
             if cc.hbm_budget_mb is not None and not cc.is_random_effect:
-                raise ValueError(
-                    f"coordinate {cc.name}: hbm_budget_mb applies to random-"
-                    "effect coordinates (fixed effects use layout='tiled' or "
-                    "'coo' for huge d)"
-                )
+                # the streamed FE path slices on the row axis: only row-major
+                # layouts stream; the Hessian-free out-of-core objective never
+                # materializes variances; down-sampling is a resident-batch op
+                if cc.layout not in ("auto", "dense", "ell"):
+                    raise ValueError(
+                        f"coordinate {cc.name}: hbm_budget_mb on a fixed "
+                        "effect requires a row-sliceable layout "
+                        f"(auto|dense|ell), got layout={cc.layout!r}"
+                    )
+                if cc.config.variance_type.upper() != "NONE":
+                    raise ValueError(
+                        f"coordinate {cc.name}: variance="
+                        f"{cc.config.variance_type.upper()} is not supported "
+                        "with hbm_budget_mb on a fixed effect (out-of-core "
+                        "row slices never materialize the Hessian); use "
+                        "variance=NONE"
+                    )
+                if cc.config.down_sampling_rate < 1.0:
+                    raise ValueError(
+                        f"coordinate {cc.name}: down_sampling_rate < 1 is not "
+                        "supported with hbm_budget_mb on a fixed effect"
+                    )
             if cc.hbm_budget_mb is not None and mesh is not None:
                 raise ValueError(
                     f"coordinate {cc.name}: streamed (hbm_budget_mb) and "
-                    "mesh-sharded random effects are not composable yet — "
+                    "mesh-sharded coordinates are not composable yet — "
                     "streaming scales one chip's HBM, the mesh shards "
-                    "entities across chips"
+                    "across chips"
                 )
             if cc.layout == "tiled":
                 if mesh is None:
@@ -245,7 +265,15 @@ class GameEstimator(EventEmitter):
                         layout=cc.layout,
                         mesh=self.mesh,
                         feature_dtype=cc.feature_dtype,
+                        hbm_budget_bytes=(
+                            cc.hbm_budget_mb * (1 << 20)
+                            if cc.hbm_budget_mb is not None
+                            else None
+                        ),
                     )
+                    if ds.streamed:
+                        datasets[cc.name] = ds
+                        continue
                     if self.mesh is not None and cc.layout != "tiled":
                         from ..parallel.mesh import shard_batch
 

@@ -84,6 +84,8 @@ class FixedEffectCoordinate(Coordinate):
         residual_scores: Optional[Array],
         initial_model: Optional[FixedEffectModel] = None,
     ) -> Tuple[FixedEffectModel, SolverResult]:
+        if self.dataset.streamed:
+            return self._train_streamed(residual_scores, initial_model)
         batch = self.dataset.batch
         if residual_scores is not None:
             # residual scores live in true sample space; padded batch rows
@@ -144,7 +146,60 @@ class FixedEffectCoordinate(Coordinate):
             result,
         )
 
+    def _train_streamed(
+        self,
+        residual_scores: Optional[Array],
+        initial_model: Optional[FixedEffectModel] = None,
+    ) -> Tuple[FixedEffectModel, SolverResult]:
+        """Out-of-core FE solve: host-resident rows streamed through the chip
+        in double-buffered row slices (game/fe_streaming.py; the reference's
+        DISK_ONLY spill + treeAggregate scale path for the fixed effect,
+        AvroDataReader.scala:165-209)."""
+        ds = self.dataset
+        hb = ds.host_batch
+        if self.config.down_sampling_rate < 1.0:
+            raise ValueError(
+                f"coordinate {self.coordinate_id}: down_sampling_rate < 1 is"
+                " not supported on the streamed fixed-effect path; raise"
+                " hbm.budget.mb so the batch is HBM-resident, or disable"
+                " down-sampling"
+            )
+        if faults.active():
+            # same fault site as the resident path: corrupt the host offsets
+            # feeding this solve (faults.corrupt copies numpy leaves)
+            hb = dataclasses.replace(
+                hb, offsets=faults.corrupt("solver.value_and_grad", hb.offsets)
+            )
+        problem = GLMProblem(
+            task=self.task,
+            config=self.config,
+            normalization=self.normalization,
+            prior=self.prior_model.model.coefficients if self.prior_model else None,
+        )
+        glm, result = problem.run_streamed(
+            hb,
+            ds.hbm_budget_bytes,
+            residual_scores=residual_scores,
+            initial_model=initial_model.model if initial_model else None,
+        )
+        return (
+            FixedEffectModel(model=glm, feature_shard=ds.feature_shard),
+            result,
+        )
+
     def score(self, model: FixedEffectModel) -> Array:
+        if self.dataset.streamed:
+            from .fe_streaming import score_streamed_fe
+
+            hb = self.dataset.host_batch
+            dtype = hb.labels.dtype
+            means = jnp.asarray(model.model.coefficients.means, dtype)
+            d_pad = hb.dim - means.shape[0]
+            if d_pad > 0:
+                means = jnp.concatenate([means, jnp.zeros((d_pad,), means.dtype)])
+            return score_streamed_fe(
+                hb, means, self.dataset.hbm_budget_bytes, dtype
+            )
         feats = self.dataset.batch.features
         # compute in the dataset's dtype: a warm-start model loaded under an
         # x64 config is f64 and must not promote the f32 score/residual stream

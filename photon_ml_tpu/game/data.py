@@ -47,27 +47,74 @@ Array = jax.Array
 
 
 @dataclasses.dataclass(frozen=True)
+class HostRowBatch:
+    """Host-resident row-major fixed-effect training data for the streamed
+    (out-of-core) path: the row axis slices trivially for both supported
+    layouts (dense ``[n, d]`` and ELL ``idx/val [n, F]``), which is what lets
+    game/fe_streaming.py stage budget-sized row windows through the chip.
+    COO (column-sorted) and tiled (mesh) layouts are NOT row-sliceable and
+    are refused upstream (GameEstimator)."""
+
+    dim: int
+    labels: np.ndarray  # f[n] solve dtype
+    offsets: np.ndarray  # f[n]
+    weights: np.ndarray  # f[n]
+    dense: Optional[np.ndarray] = None  # f[n, d] feature dtype
+    ell_idx: Optional[np.ndarray] = None  # i32[n, F]
+    ell_val: Optional[np.ndarray] = None  # f[n, F] feature dtype
+
+    @property
+    def n_rows(self) -> int:
+        return int(self.labels.shape[0])
+
+    @property
+    def layout(self) -> str:
+        return "dense" if self.dense is not None else "ell"
+
+    def feature_row_nbytes(self) -> int:
+        if self.dense is not None:
+            return self.dim * self.dense.dtype.itemsize
+        return self.ell_idx.shape[1] * (
+            self.ell_val.dtype.itemsize + self.ell_idx.dtype.itemsize
+        )
+
+
+@dataclasses.dataclass(frozen=True)
 class FixedEffectDataset:
     """All samples' features from one shard (FixedEffectDataset.scala:26-152).
 
     ``true_dim`` / ``true_n_rows`` are the UNPADDED shard dimension and sample
     count: mesh-tiled layouts pad both to device multiples, but models and
     exchanged score vectors live in the true space (trim/pad happens at the
-    coordinate boundary)."""
+    coordinate boundary).
+
+    Out-of-core mode (game/fe_streaming.py): when ``streamed`` is set,
+    ``batch`` is None and ``host_batch`` holds the row-major host arrays;
+    training/scoring pipeline double-buffered row slices through the chip
+    under ``hbm_budget_bytes`` — the FE twin of the streamed random effects
+    (reference: DISK_ONLY spill + treeAggregate,
+    CoordinateDescent.scala:262,404 / AvroDataReader.scala:165-209)."""
 
     coordinate_id: str
     feature_shard: str
-    batch: LabeledBatch
+    batch: Optional[LabeledBatch]
     true_dim: Optional[int] = None
     true_n_rows: Optional[int] = None
+    host_batch: Optional[HostRowBatch] = None
+    streamed: bool = False
+    hbm_budget_bytes: Optional[int] = None
 
     @property
     def n_rows(self) -> int:
-        return self.true_n_rows if self.true_n_rows is not None else self.batch.n_rows
+        if self.true_n_rows is not None:
+            return self.true_n_rows
+        return self.batch.n_rows if self.batch is not None else self.host_batch.n_rows
 
     @property
     def dim(self) -> int:
-        return self.true_dim if self.true_dim is not None else self.batch.dim
+        if self.true_dim is not None:
+            return self.true_dim
+        return self.batch.dim if self.batch is not None else self.host_batch.dim
 
 
 @jax.tree_util.register_dataclass
@@ -221,7 +268,71 @@ def build_fixed_effect_dataset(
     layout: str = "auto",
     mesh=None,
     feature_dtype=None,
+    hbm_budget_bytes: Optional[int] = None,
 ) -> FixedEffectDataset:
+    """``hbm_budget_bytes``: when set and the resident device batch would
+    exceed this many bytes, the dataset is built STREAMED — features stay in
+    host numpy (dense or ELL rows) and training/scoring stream row slices
+    (game/fe_streaming.py). Streaming composes with neither the mesh nor the
+    coo/tiled layouts (refused by GameEstimator before this point)."""
+    d = raw.shard_dims[feature_shard]
+    if hbm_budget_bytes is not None and mesh is None:
+        eff_layout = layout
+        if eff_layout == "auto":
+            # same rule as RawDataset.to_batch's auto resolution
+            eff_layout = "dense" if d <= 4096 else "ell"
+        if eff_layout not in ("dense", "ell"):
+            raise ValueError(
+                f"coordinate {coordinate_id}: hbm_budget_mb on a fixed effect "
+                f"requires a row-sliceable layout (auto|dense|ell), got "
+                f"layout={layout!r}"
+            )
+        from .fe_streaming import estimate_fe_batch_bytes
+
+        fdt = np.dtype(jnp.zeros((), feature_dtype or dtype).dtype)
+        sdt = np.dtype(jnp.zeros((), dtype).dtype)
+        rows, cols, vals = raw.shard_coo[feature_shard]
+        n = raw.n_rows
+        if eff_layout == "ell":
+            counts = np.bincount(rows, minlength=n) if n else np.zeros(0, np.int64)
+            width = max(int(counts.max()) if n else 1, 1)
+        else:
+            width = 0
+        est = estimate_fe_batch_bytes(
+            n, d, eff_layout, ell_width=width,
+            feature_itemsize=fdt.itemsize, scalar_itemsize=sdt.itemsize,
+        )
+        if est > hbm_budget_bytes:
+            if eff_layout == "dense":
+                dense = np.zeros((n, d), np.float64)
+                np.add.at(dense, (rows, cols), vals)
+                host = HostRowBatch(
+                    dim=d,
+                    labels=raw.labels.astype(sdt),
+                    offsets=raw.offsets.astype(sdt),
+                    weights=raw.weights.astype(sdt),
+                    dense=dense.astype(fdt),
+                )
+            else:
+                ell_idx, ell_val = _rows_to_ell(rows, cols, vals, n, width=width)
+                host = HostRowBatch(
+                    dim=d,
+                    labels=raw.labels.astype(sdt),
+                    offsets=raw.offsets.astype(sdt),
+                    weights=raw.weights.astype(sdt),
+                    ell_idx=ell_idx,
+                    ell_val=ell_val.astype(fdt),
+                )
+            return FixedEffectDataset(
+                coordinate_id=coordinate_id,
+                feature_shard=feature_shard,
+                batch=None,
+                true_dim=d,
+                true_n_rows=n,
+                host_batch=host,
+                streamed=True,
+                hbm_budget_bytes=hbm_budget_bytes,
+            )
     return FixedEffectDataset(
         coordinate_id=coordinate_id,
         feature_shard=feature_shard,

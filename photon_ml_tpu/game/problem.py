@@ -18,6 +18,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from .. import obs
 from ..models.coefficients import Coefficients
 from ..models.glm import GeneralizedLinearModel, model_for_task
 from ..ops.features import LabeledBatch
@@ -213,6 +214,87 @@ class GLMProblem:
 
         model = model_for_task(
             self.task, Coefficients(means=means, variances=variances)
+        )
+        return model, result
+
+    def run_streamed(
+        self,
+        host_batch,  # game.data.HostRowBatch
+        budget_bytes: int,
+        residual_scores: Optional[Array] = None,  # device f[n] or None
+        initial_model: Optional[GeneralizedLinearModel] = None,
+    ) -> Tuple[GeneralizedLinearModel, SolverResult]:
+        """Train out-of-core: row slices of the host batch stream through the
+        chip double-buffered (game/fe_streaming.py) while the optimizer runs
+        on the host (optimize/host_driver.py) — the reference's
+        Breeze-on-the-driver + treeAggregate-per-evaluation split. Same
+        normalization / warm-start / prior semantics as ``run``; returns a
+        host-materialized SolverResult."""
+        import time as _time
+
+        from ..optimize import host_optimize
+        from .fe_streaming import StreamedFEObjective
+
+        vt = self.config.variance_type.upper()
+        if vt != "NONE":
+            raise ValueError(
+                f"variance={vt} is not supported on the streamed fixed-effect"
+                " path (out-of-core row slices never materialize the Hessian);"
+                " use variance=NONE or raise hbm.budget.mb so the batch is"
+                " HBM-resident"
+            )
+        dim = host_batch.dim
+        dtype = host_batch.labels.dtype
+        norm = None
+        if self.normalization is not None:
+            norm = self.normalization.padded(dim)
+        prior_mean = prior_precision = None
+        if self.prior is not None:
+            prior_mean = jnp.asarray(self.prior.means, dtype)
+            if self.normalization is not None:
+                prior_mean = self.normalization.model_to_transformed_space(prior_mean)
+            if self.prior.variances is not None:
+                var = jnp.asarray(self.prior.variances, dtype)
+                prior_precision = 1.0 / jnp.maximum(var, 1e-12)
+            else:
+                prior_precision = jnp.ones_like(prior_mean)
+        if initial_model is not None:
+            w0 = jnp.asarray(initial_model.coefficients.means, dtype)
+            if self.normalization is not None:
+                w0 = self.normalization.model_to_transformed_space(w0)
+            w0 = np.asarray(jax.device_get(w0))
+        else:
+            w0 = np.zeros(dim, dtype)
+
+        obj = StreamedFEObjective(
+            get_loss(self.task),
+            host_batch,
+            budget_bytes,
+            norm=norm,
+            l2_weight=self.config.regularization.l2_weight(self.config.reg_weight),
+            prior_mean=prior_mean,
+            prior_precision=prior_precision,
+            residual_scores=residual_scores,
+        )
+        t0 = _time.perf_counter()
+        with obs.span(
+            "fe_stream.solve",
+            n_slices=obj.n_slices,
+            budget_bytes=int(budget_bytes),
+        ):
+            result = host_optimize(
+                obj.value_and_grad,
+                w0,
+                self.config.solver_config(),
+                hvp=obj.hessian_vector,
+            )
+        obj.record_metrics("fe.train", _time.perf_counter() - t0)
+
+        means = jnp.asarray(result.coefficients, dtype)
+        if self.normalization is not None:
+            means = norm.model_to_original_space(means)
+        model = model_for_task(
+            self.task, Coefficients(means=means, variances=None)
         )
         return model, result
 

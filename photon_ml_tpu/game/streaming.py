@@ -222,26 +222,28 @@ def solve_streamed(
         collect(*pending)
 
     reg = obs.current_run().registry
+    # site label distinguishes this (entity-sliced RE) path from the
+    # row-sliced fixed-effect path (fe_streaming.py, site="fe.train")
     reg.counter(
-        "photon_stream_slices_total", "streamed entity slices solved"
-    ).labels().inc(len(slices))
+        "photon_stream_slices_total", "streamed slices staged through the chip"
+    ).labels(site="re.train").inc(len(slices))
     reg.counter(
         "photon_stream_staged_bytes_total", "host bytes staged to device"
-    ).labels().inc(staged_stats["total_bytes"])
-    reg.gauge("photon_stream_budget_bytes", "configured HBM budget").labels().set(
-        budget_bytes
-    )
+    ).labels(site="re.train").inc(staged_stats["total_bytes"])
+    reg.gauge(
+        "photon_stream_budget_bytes", "configured HBM budget"
+    ).labels(site="re.train").set(budget_bytes)
     reg.gauge(
         "photon_stream_estimated_slice_bytes",
         "largest slice footprint by the block-byte estimator",
-    ).labels().set(est_max_slice)
+    ).labels(site="re.train").set(est_max_slice)
     reg.gauge(
         "photon_stream_actual_slice_bytes", "largest slice actually staged"
-    ).labels().set(staged_stats["max_slice_bytes"])
+    ).labels(site="re.train").set(staged_stats["max_slice_bytes"])
     reg.gauge(
         "photon_stream_budget_headroom_bytes",
         "budget minus double-buffered peak (negative = over budget)",
-    ).labels().set(budget_bytes - 2 * staged_stats["max_slice_bytes"])
+    ).labels(site="re.train").set(budget_bytes - 2 * staged_stats["max_slice_bytes"])
 
     return SolverResult(
         coefficients=out_coef,
@@ -254,6 +256,27 @@ def solve_streamed(
     )
 
 
+class StreamedScoreCache:
+    """One-time host-side regroup of rows by entity slice (plus the x_sub
+    densification) reused across score sweeps.
+
+    ``slice_rows[k]`` holds the row indices whose entity falls in slice k,
+    padded with the out-of-range sentinel ``n`` up to a power-of-two bucket
+    so repeated sweeps reuse O(log n) compiled shapes. ``device_rows`` is the
+    total padded row count gathered per sweep — the device work counter the
+    flat-wall assertion checks (<= 2n regardless of slice count)."""
+
+    def __init__(self, x_sub, step, slice_rows, device_rows):
+        self.x_sub = x_sub  # [n, S] device
+        self.step = step
+        self.slice_rows = slice_rows  # per-slice device i32[m_k], pad = n
+        self.device_rows = device_rows
+
+
+def _pow2_ceil(x: int) -> int:
+    return 1 << max(int(x) - 1, 0).bit_length()
+
+
 def score_streamed(
     coef_values_np: np.ndarray,  # [E, S] host model table
     proj_cols_np: np.ndarray,  # [E, S] host support layout
@@ -261,23 +284,22 @@ def score_streamed(
     ell_idx: Array,  # device i32[n, F]
     ell_val: Array,  # device f[n, F]
     budget_bytes: int,
-    xsub_cache: Optional[Array] = None,
+    cache: Optional[StreamedScoreCache] = None,
     score_dtype=None,
 ) -> tuple:
     """Score all rows against a host-resident per-entity coefficient table by
     streaming entity slices of the table through the device.
 
-    Returns (scores [n], x_sub cache to reuse across sweeps). The x_sub
-    densification (row features in entity-subspace layout) is itself built
-    slice-by-slice on the first call — it is row-sized [n, S], which is
-    device-resident by assumption (the ELL arrays already are).
+    Returns (scores [n], cache to reuse across sweeps). The cache holds the
+    x_sub densification (row features in entity-subspace layout — row-sized
+    [n, S], device-resident by assumption like the ELL arrays) plus a
+    one-time host regroup of rows by entity slice.
 
-    Cost shape: each slice does O(n) row work (gather + dot) under a slice
-    mask, so a sweep's scoring is O(n * n_slices). The scoring table is only
-    E*S*itemsize bytes (no K factor), so its slice count under the same
-    budget is far smaller than the training loop's; rows are NOT regrouped
-    by slice (that would need per-slice dynamic shapes and a compile per
-    slice size)."""
+    Cost shape: rows are regrouped by slice once (stable argsort of
+    row_entity on host), so each sweep's slice k touches ONLY its own rows —
+    a gather + dot over m_k padded rows with sum(m_k) <= 2n. A sweep is O(n)
+    total regardless of slice count (previously each slice did masked O(n)
+    work, making sweeps O(n * n_slices))."""
     from ..models.game import ell_support_positions
 
     E, S = coef_values_np.shape
@@ -289,7 +311,11 @@ def score_streamed(
     if score_dtype is None:
         score_dtype = jnp.promote_types(ell_val.dtype, jnp.float32)
 
-    if xsub_cache is None:
+    if cache is not None and not isinstance(cache, StreamedScoreCache):
+        # pre-regroup callers cached the bare x_sub array
+        cache = StreamedScoreCache(cache, -1, None, 0)
+
+    if cache is None or cache.x_sub is None:
         x_sub = jnp.zeros((n, S), ell_val.dtype)
         for s0 in range(0, E, step):
             s1 = min(s0 + step, E)
@@ -302,16 +328,61 @@ def score_streamed(
             pos, hit = ell_support_positions(pc, loc, ell_idx)
             contrib = jnp.where(hit & in_sl[:, None], ell_val, 0.0)
             x_sub = x_sub.at[jnp.arange(n)[:, None], pos].add(contrib)
-        xsub_cache = x_sub
+        cache = StreamedScoreCache(x_sub, -1, None, 0)
 
-    xsub_wide = xsub_cache.astype(score_dtype)  # hoisted: cast once per sweep
+    if cache.step != step or cache.slice_rows is None:
+        # one-time regroup: rows sorted by entity are contiguous by slice;
+        # per-slice groups pad to power-of-two buckets (sentinel n) so sweeps
+        # reuse O(log n) compiled shapes and total padded work stays <= 2n
+        re_np = np.asarray(
+            logged_fetch("streaming.score_regroup", row_entity)
+        ).astype(np.int64)
+        order = np.argsort(re_np, kind="stable")
+        edges = np.arange(0, E + step, step)[: (E + step - 1) // step + 1]
+        bounds = np.searchsorted(re_np[order], edges)
+        slice_rows = []
+        device_rows = 0
+        for k in range(len(edges) - 1):
+            rows = order[bounds[k] : bounds[k + 1]]
+            if len(rows) == 0:
+                slice_rows.append(None)
+                continue
+            m = _pow2_ceil(len(rows))
+            padded = np.full(m, n, dtype=np.int32)
+            padded[: len(rows)] = rows
+            slice_rows.append(jax.device_put(padded))
+            device_rows += m
+        cache = StreamedScoreCache(cache.x_sub, step, slice_rows, device_rows)
+        reg = obs.current_run().registry
+        reg.gauge(
+            "photon_stream_score_device_rows",
+            "padded rows gathered per streamed score sweep "
+            "(O(n), flat in slice count)",
+        ).labels(site="re.score").set(device_rows)
+
+    xsub_wide = cache.x_sub.astype(score_dtype)  # hoisted: cast once per sweep
     scores = jnp.zeros(n, score_dtype)
-    for s0 in range(0, E, step):
-        s1 = min(s0 + step, E)
-        w = jax.device_put(np.ascontiguousarray(coef_values_np[s0:s1]))
-        in_sl = (row_entity >= s0) & (row_entity < s1)
-        loc = jnp.where(in_sl, row_entity - s0, 0)
-        wr = jnp.take(w, loc, axis=0).astype(score_dtype)  # [n, S]
-        part = jnp.sum(wr * xsub_wide, axis=1)
-        scores = scores + jnp.where(in_sl, part, 0.0)
-    return scores, xsub_cache
+    n_slices = (E + step - 1) // step
+    for k in range(n_slices):
+        idx = cache.slice_rows[k]
+        if idx is None:
+            continue
+        s0 = k * step
+        e_k = min(s0 + step, E) - s0
+        # pad the table slice to `step` entities so every slice shares one
+        # compiled shape (the tail would otherwise compile separately)
+        w_np = np.zeros((step, S), coef_values_np.dtype)
+        w_np[:e_k] = coef_values_np[s0 : s0 + e_k]
+        w = jax.device_put(w_np)
+        # sentinel rows (idx == n) read entity s0's coefficients against a
+        # zero-filled feature row and are dropped by the scatter below
+        loc = jnp.take(row_entity, idx, mode="fill", fill_value=s0) - s0
+        wr = jnp.take(w, loc, axis=0).astype(score_dtype)  # [m, S]
+        xr = jnp.take(xsub_wide, idx, axis=0, mode="fill", fill_value=0)
+        part = jnp.sum(wr * xr.astype(score_dtype), axis=1)
+        scores = scores.at[idx].add(part, mode="drop")
+    reg = obs.current_run().registry
+    reg.counter(
+        "photon_stream_slices_total", "streamed slices staged through the chip"
+    ).labels(site="re.score").inc(n_slices)
+    return scores, cache

@@ -5,6 +5,7 @@ from .data import (
     RawDataset,
     build_index_maps,
     read_avro_dataset,
+    read_avro_dataset_chunked,
     read_libsvm,
     records_to_dataset,
 )
@@ -20,6 +21,7 @@ __all__ = [
     "InputColumnsNames",
     "RawDataset",
     "read_avro_dataset",
+    "read_avro_dataset_chunked",
     "read_libsvm",
     "records_to_dataset",
     "build_index_maps",

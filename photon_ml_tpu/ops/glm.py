@@ -289,6 +289,95 @@ def _pin_zero_diagonal(h: Array) -> Array:
     return h.at[i, i].set(jnp.where(dg == 0, jnp.ones((), h.dtype), dg))
 
 
+# ---------------------------------------------------------------------------
+# Sliced aggregators: the out-of-core fixed-effect objective
+# (game/fe_streaming.py) streams row slices through the chip and needs the
+# objective split into per-slice partial sums plus one finalize step. The
+# decomposition is exact, not approximate: value, X^T(w dz), sum(w dz),
+# X^T c and sum(c) are all plain row sums, while the normalization
+# shift/factor algebra, the prior delta and the L2 term depend only on the
+# coefficient vector — so they apply ONCE to the accumulated totals and the
+# streamed objective equals the resident one up to float summation order.
+# (Reference: the same split between the per-partition seqOp and the driver-
+# side combOp of ValueAndGradientAggregator.scala:36-161.)
+
+
+def slice_value_grad_partials(
+    loss: PointwiseLoss,
+    batch_slice: LabeledBatch,
+    eff: Array,
+    mshift: Array,
+) -> Tuple[Array, Array, Array]:
+    """Per-row-slice partial sums of the GLM objective: (sum_i w_i l_i,
+    X_slice^T (w dz), sum_i w_i dz_i). ``eff``/``mshift`` are the
+    normalization-effective coefficients (norm.effective_coefficients),
+    computed once per evaluation, not per slice."""
+    b = batch_slice
+    z = b.features.matvec(eff) + mshift + b.offsets
+    l, dz = loss.loss_and_dz(z, b.labels)
+    wdz = b.weights * dz
+    return jnp.sum(b.weights * l), b.features.rmatvec(wdz), jnp.sum(wdz)
+
+
+def slice_hessian_vector_partials(
+    loss: PointwiseLoss,
+    batch_slice: LabeledBatch,
+    eff: Array,
+    mshift: Array,
+    eff_v: Array,
+    vshift: Array,
+) -> Tuple[Array, Array]:
+    """Per-row-slice partial sums of H v: (X_slice^T c, sum_i c_i) with
+    c = w l''(z) u and u = x.eff_v + vshift (hessian_vector's row terms)."""
+    b = batch_slice
+    z = b.features.matvec(eff) + mshift + b.offsets
+    c = b.weights * loss.d2z(z, b.labels) * (b.features.matvec(eff_v) + vshift)
+    return b.features.rmatvec(c), jnp.sum(c)
+
+
+def finalize_value_grad(
+    coef: Array,
+    value_sum: Array,
+    raw_grad_sum: Array,
+    wdz_sum: Array,
+    norm: NormalizationContext,
+    l2: Array,
+    prior_mean: Optional[Array],
+    prior_precision: Optional[Array],
+) -> Tuple[Array, Array]:
+    """Apply the per-evaluation (not per-slice) algebra of
+    GLMObjective.value_and_grad to accumulated slice partials."""
+    grad = raw_grad_sum
+    if norm.shifts is not None:
+        grad = grad - norm.shifts * wdz_sum
+    if norm.factors is not None:
+        grad = grad * norm.factors
+    delta = coef if prior_mean is None else coef - prior_mean
+    prec = jnp.ones_like(coef) if prior_precision is None else prior_precision
+    value = value_sum + 0.5 * l2 * jnp.dot(delta, prec * delta)
+    grad = grad + l2 * prec * delta
+    return value, grad
+
+
+def finalize_hessian_vector(
+    v: Array,
+    hv_sum: Array,
+    csum: Array,
+    norm: NormalizationContext,
+    l2: Array,
+    prior_precision: Optional[Array],
+) -> Array:
+    """Apply GLMObjective.hessian_vector's post-accumulation algebra to
+    accumulated slice partials."""
+    hv = hv_sum
+    if norm.shifts is not None:
+        hv = hv - norm.shifts * csum
+    if norm.factors is not None:
+        hv = hv * norm.factors
+    prec = jnp.ones_like(v) if prior_precision is None else prior_precision
+    return hv + l2 * prec * v
+
+
 def _vg(obj: "GLMObjective", coef: Array):
     return obj.value_and_grad(coef)
 

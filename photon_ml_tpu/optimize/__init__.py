@@ -9,6 +9,7 @@ from .common import (
 from .lbfgs import solve_lbfgs
 from .tron import solve_tron
 from .driver import optimize
+from .host_driver import host_optimize, solve_lbfgs_host, solve_tron_host
 
 __all__ = [
     "ConvergenceReason",
@@ -20,4 +21,7 @@ __all__ = [
     "solve_lbfgs",
     "solve_tron",
     "optimize",
+    "host_optimize",
+    "solve_lbfgs_host",
+    "solve_tron_host",
 ]

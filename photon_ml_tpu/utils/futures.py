@@ -1,0 +1,51 @@
+"""Daemon-thread futures for background decode pipelines.
+
+Extracted from cli/train's background validation decode so io/data's chunked
+training-data reader can share it (one-part lookahead decode).
+"""
+
+from __future__ import annotations
+
+import threading
+
+
+class DaemonFuture:
+    """Future-shaped handle on a fn run in a DAEMON thread.
+
+    Replaces ThreadPoolExecutor for background decodes: executor threads are
+    non-daemon and concurrent.futures joins them at interpreter exit, so a
+    training crash mid-decode used to block process exit on a full decode
+    nobody will consume. A daemon thread is abandoned at exit — a crash
+    anywhere exits bounded. The flip side: "cancellation" is only ever
+    not-waiting; work that already STARTED runs to completion in the
+    background (the thread starts on construction, so a live decode is never
+    killed, merely never joined)."""
+
+    def __init__(self, fn):
+        self._done = threading.Event()
+        self._value = None
+        self._error = None
+
+        def _work():
+            try:
+                self._value = fn()
+            # photon: ignore[R4] — future semantics: stored, re-raised in result()
+            except BaseException as e:
+                self._error = e
+            finally:
+                self._done.set()
+
+        self._thread = threading.Thread(
+            target=_work, name="photon-bg-decode", daemon=True
+        )
+        self._thread.start()
+
+    def done(self) -> bool:
+        return self._done.is_set()
+
+    def result(self, timeout=None):
+        if not self._done.wait(timeout):
+            raise TimeoutError("background work still running")
+        if self._error is not None:
+            raise self._error
+        return self._value

@@ -467,3 +467,105 @@ def test_v1_index_store_still_loads(tmp_path):
         json.dump({"shard": "g", "numPartitions": 1, "size": len(imap)}, f)
     loaded = load_partitioned(out, "g")
     assert dict(loaded.items()) == dict(imap.items())
+
+
+# -------------------------------------------------------- chunked ingest
+
+
+def _write_parts(tmp_path, n_parts=4, per_part=60):
+    recs = _mk_records(n=n_parts * per_part, d=8, seed=5)
+    d = tmp_path / "parts"
+    d.mkdir()
+    for i in range(n_parts):
+        write_avro_file(
+            str(d / f"part-{i:05d}.avro"),
+            TRAINING_EXAMPLE_AVRO,
+            recs[i * per_part : (i + 1) * per_part],
+        )
+    return str(d)
+
+
+def _assert_same_dataset(a, b):
+    assert a.n_rows == b.n_rows
+    np.testing.assert_array_equal(a.labels, b.labels)
+    np.testing.assert_array_equal(a.offsets, b.offsets)
+    np.testing.assert_array_equal(a.weights, b.weights)
+    assert a.shard_dims == b.shard_dims
+    for s in a.shard_coo:
+        for x, y in zip(a.shard_coo[s], b.shard_coo[s]):
+            np.testing.assert_array_equal(x, y)
+    for t in a.id_tags:
+        np.testing.assert_array_equal(a.id_tags[t], b.id_tags[t])
+    np.testing.assert_array_equal(a.uids, b.uids)
+
+
+def test_chunked_reader_matches_monolithic(tmp_path):
+    """The pipelined per-part reader is bit-identical to the full-decode
+    reader: same rows in the same order, same index maps, same COO triples —
+    with and without prebuilt maps."""
+    from photon_ml_tpu.io import read_avro_dataset_chunked
+
+    path = _write_parts(tmp_path)
+    shards = {"g": FeatureShardConfig(feature_bags=("features",))}
+    mono, maps_m = read_avro_dataset(
+        path, shards, id_tag_columns=("userId",), engine="python"
+    )
+    chunk, maps_c = read_avro_dataset_chunked(
+        path, shards, id_tag_columns=("userId",), engine="python"
+    )
+    _assert_same_dataset(mono, chunk)
+    assert {s: dict(m.items()) for s, m in maps_m.items()} == {
+        s: dict(m.items()) for s, m in maps_c.items()
+    }
+    # prebuilt maps skip the keys pass but land on the same dataset
+    pre, _ = read_avro_dataset_chunked(
+        path, shards, index_maps=maps_m, id_tag_columns=("userId",), engine="python"
+    )
+    _assert_same_dataset(mono, pre)
+    # single part: delegates to the monolithic reader
+    one, _ = read_avro_dataset_chunked(
+        os.path.join(path, "part-00000.avro"), shards, index_maps=maps_m,
+        engine="python",
+    )
+    assert one.n_rows == 60
+
+
+def test_chunked_reader_bounds_peak_memory(tmp_path):
+    """Acceptance: chunked ingest keeps peak host allocation below the
+    full-decode path on multi-part input (the record list never fully
+    materializes — residency is ~2 parts)."""
+    import tracemalloc
+
+    from photon_ml_tpu.io import read_avro_dataset_chunked
+
+    path = _write_parts(tmp_path, n_parts=6, per_part=120)
+    shards = {"g": FeatureShardConfig(feature_bags=("features",))}
+    _, maps = read_avro_dataset(path, shards, engine="python")
+
+    tracemalloc.start()
+    read_avro_dataset(path, shards, index_maps=maps, engine="python")
+    mono_peak = tracemalloc.get_traced_memory()[1]
+    tracemalloc.stop()
+
+    tracemalloc.start()
+    read_avro_dataset_chunked(path, shards, index_maps=maps, engine="python")
+    chunk_peak = tracemalloc.get_traced_memory()[1]
+    tracemalloc.stop()
+
+    assert chunk_peak < mono_peak
+
+
+def test_chunked_reader_counts_parts(tmp_path):
+    from photon_ml_tpu import obs
+    from photon_ml_tpu.io import read_avro_dataset_chunked
+
+    path = _write_parts(tmp_path, n_parts=3, per_part=20)
+    shards = {"g": FeatureShardConfig(feature_bags=("features",))}
+    run = obs.RunTelemetry()
+    with obs.use_run(run):
+        ds, _ = read_avro_dataset_chunked(path, shards, engine="python")
+    snap = {
+        (m["name"], m["labels"].get("mode")): m for m in run.registry.snapshot()
+    }
+    assert snap[("photon_ingest_parts_total", "chunked")]["value"] == 3
+    assert snap[("photon_ingest_rows_total", "chunked")]["value"] == ds.n_rows == 60

@@ -109,34 +109,71 @@ def test_streamed_warm_start_and_prior(raw, monkeypatch):
     )
 
 
-def test_estimator_refuses_streamed_fixed_and_mesh():
+def test_estimator_streamed_fixed_policy_and_mesh():
+    """A streamed FIXED effect is now supported — but only on row-sliceable
+    layouts, variance NONE, full sampling, and without a mesh."""
+    import dataclasses
+
     from photon_ml_tpu.estimators.game_estimator import CoordinateConfig, GameEstimator
     from photon_ml_tpu.parallel import make_mesh
 
     cfg = _cfg()
-    with pytest.raises(ValueError, match="hbm_budget_mb"):
+    # supported: plain streamed FE config constructs fine
+    GameEstimator(
+        task="logistic_regression",
+        coordinate_configs=[
+            CoordinateConfig(
+                name="global", feature_shard="g", config=cfg, hbm_budget_mb=64
+            )
+        ],
+    )
+    with pytest.raises(ValueError, match="row-sliceable layout"):
         GameEstimator(
             task="logistic_regression",
             coordinate_configs=[
                 CoordinateConfig(
-                    name="global", feature_shard="g", config=cfg, hbm_budget_mb=64
+                    name="global", feature_shard="g", config=cfg,
+                    hbm_budget_mb=64, layout="coo",
                 )
             ],
         )
-    with pytest.raises(ValueError, match="not composable"):
+    with pytest.raises(ValueError, match="variance"):
         GameEstimator(
             task="logistic_regression",
             coordinate_configs=[
                 CoordinateConfig(
-                    name="re",
-                    feature_shard="s",
-                    config=cfg,
-                    random_effect_type="userId",
+                    name="global", feature_shard="g",
+                    config=dataclasses.replace(cfg, variance_type="SIMPLE"),
                     hbm_budget_mb=64,
                 )
             ],
-            mesh=make_mesh(n_data=8),
         )
+    with pytest.raises(ValueError, match="down_sampling_rate"):
+        GameEstimator(
+            task="logistic_regression",
+            coordinate_configs=[
+                CoordinateConfig(
+                    name="global", feature_shard="g",
+                    config=dataclasses.replace(cfg, down_sampling_rate=0.5),
+                    hbm_budget_mb=64,
+                )
+            ],
+        )
+    for extra in (
+        dict(),  # fixed effect
+        dict(random_effect_type="userId"),  # random effect
+    ):
+        with pytest.raises(ValueError, match="not composable"):
+            GameEstimator(
+                task="logistic_regression",
+                coordinate_configs=[
+                    CoordinateConfig(
+                        name="c", feature_shard="s", config=cfg,
+                        hbm_budget_mb=64, **extra,
+                    )
+                ],
+                mesh=make_mesh(n_data=8),
+            )
 
 
 def test_cli_trains_streamed_re_with_parity(tmp_path):

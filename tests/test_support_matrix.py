@@ -1,0 +1,265 @@
+"""The README "Support matrix" is load-bearing documentation: every refused
+combination in its ledger is asserted here against the actual refusal site,
+so the table cannot drift from the code (and vice versa — removing a refusal
+without updating the docs fails too).
+
+Each case pins (a) the quoted message fragment appears verbatim in the
+README ledger, and (b) triggering the combination raises with a message
+containing that exact fragment. The matrix itself must be present in both
+README.md and MIGRATION.md.
+"""
+
+from __future__ import annotations
+
+import re
+from pathlib import Path
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from photon_ml_tpu.estimators.game_estimator import CoordinateConfig, GameEstimator
+from photon_ml_tpu.game.problem import GLMOptimizationConfig, GLMProblem
+from photon_ml_tpu.ops.glm import MAX_FULL_VARIANCE_DIM, check_full_variance_dim
+from photon_ml_tpu.ops.normalization import build_normalization
+from photon_ml_tpu.ops.regularization import RegularizationContext
+from photon_ml_tpu.parallel import mesh as mesh_mod
+from photon_ml_tpu.testing import generate_mixed_effect_data
+from photon_ml_tpu.testing.generators import mixed_data_to_raw_dataset
+
+ROOT = Path(__file__).resolve().parents[1]
+
+
+@pytest.fixture(scope="module")
+def readme_text():
+    return (ROOT / "README.md").read_text()
+
+
+@pytest.fixture(scope="module")
+def migration_text():
+    return (ROOT / "MIGRATION.md").read_text()
+
+
+@pytest.fixture(scope="module")
+def raw():
+    data = generate_mixed_effect_data(
+        n=80, d_fixed=5, re_specs={"userId": (6, 3)}, seed=3
+    )
+    return mixed_data_to_raw_dataset(data)
+
+
+def _cfg(**kw):
+    return GLMOptimizationConfig(
+        regularization=RegularizationContext("L2"), reg_weight=1.0, **kw
+    )
+
+
+def _estimator(ccs, mesh=None):
+    return GameEstimator(
+        task="logistic_regression", coordinate_configs=ccs, mesh=mesh
+    )
+
+
+def _fe(name="global", **kw):
+    return CoordinateConfig(
+        name=name, feature_shard="global", config=kw.pop("config", _cfg()), **kw
+    )
+
+
+# -- the refusal triggers (one per ledger row) -------------------------------
+
+
+def _trigger_feature_dtype_tiled(raw):
+    _estimator([_fe(layout="tiled", feature_dtype=jnp.bfloat16)])
+
+
+def _trigger_feature_dtype_tiled_batch(raw):
+    raw.to_batch("global", layout="tiled", feature_dtype=jnp.bfloat16)
+
+
+def _trigger_tiled_no_mesh(raw):
+    _estimator([_fe(layout="tiled")])
+
+
+def _trigger_tiled_batch_no_mesh(raw):
+    raw.to_batch("global", layout="tiled")
+
+
+def _trigger_streamed_fe_bad_layout(raw):
+    _estimator([_fe(layout="coo", hbm_budget_mb=1)])
+
+
+def _trigger_streamed_fe_variance(raw):
+    _estimator([_fe(config=_cfg(variance_type="SIMPLE"), hbm_budget_mb=1)])
+
+
+def _trigger_streamed_fe_down_sampling(raw):
+    _estimator([_fe(config=_cfg(down_sampling_rate=0.5), hbm_budget_mb=1)])
+
+
+def _trigger_streamed_fe_deep_variance(raw):
+    # the train-time re-check behind the estimator gate: direct GLMProblem use
+    GLMProblem(
+        task="logistic_regression", config=_cfg(variance_type="FULL")
+    ).run_streamed(None, 1 << 20)
+
+
+def _trigger_streamed_and_mesh(raw):
+    _estimator(
+        [_fe(hbm_budget_mb=1)], mesh=mesh_mod.make_mesh(n_data=len(jax.devices()))
+    )
+
+
+def _trigger_full_variance_ceiling(raw):
+    check_full_variance_dim(MAX_FULL_VARIANCE_DIM + 1)
+
+
+def _trigger_standardization_no_intercept(raw):
+    d = 4
+    build_normalization(
+        "STANDARDIZATION", np.ones(d), np.ones(d), np.ones(d), intercept_index=None
+    )
+
+
+def _trigger_coo_on_mesh(raw):
+    batch = raw.to_batch("global", layout="coo")
+    mesh_mod.shard_batch(batch, mesh_mod.make_mesh(n_data=len(jax.devices())))
+
+
+def _trigger_multiprocess_ell(raw, monkeypatch):
+    batch = raw.to_batch("global", layout="ell")
+    monkeypatch.setattr(jax, "process_count", lambda: 2)
+    mesh_mod.shard_batch(batch, mesh_mod.make_mesh(n_data=len(jax.devices())))
+
+
+def _trigger_multiprocess_model_axis(raw, monkeypatch):
+    monkeypatch.setattr(jax, "process_count", lambda: 2)
+    mesh_mod.shard_coefficients(
+        jnp.zeros(8), mesh_mod.make_mesh(n_data=len(jax.devices()))
+    )
+
+
+CASES = [
+    # (id, documented message fragment, exception type, trigger)
+    (
+        "feature-dtype-tiled-estimator",
+        "feature_dtype is not supported with layout='tiled'",
+        ValueError,
+        _trigger_feature_dtype_tiled,
+    ),
+    (
+        "feature-dtype-tiled-batch",
+        "feature_dtype is not supported on the tiled layout",
+        ValueError,
+        _trigger_feature_dtype_tiled_batch,
+    ),
+    (
+        "tiled-no-mesh-estimator",
+        "layout='tiled' requires the estimator to be built with a device mesh",
+        ValueError,
+        _trigger_tiled_no_mesh,
+    ),
+    (
+        "tiled-no-mesh-batch",
+        "layout='tiled' requires a device mesh",
+        ValueError,
+        _trigger_tiled_batch_no_mesh,
+    ),
+    (
+        "streamed-fe-bad-layout",
+        "hbm_budget_mb on a fixed effect requires a row-sliceable layout",
+        ValueError,
+        _trigger_streamed_fe_bad_layout,
+    ),
+    (
+        "streamed-fe-variance",
+        "is not supported with hbm_budget_mb on a fixed effect "
+        "(out-of-core row slices never materialize the Hessian)",
+        ValueError,
+        _trigger_streamed_fe_variance,
+    ),
+    (
+        "streamed-fe-down-sampling",
+        "down_sampling_rate < 1 is not supported with hbm_budget_mb on a "
+        "fixed effect",
+        ValueError,
+        _trigger_streamed_fe_down_sampling,
+    ),
+    (
+        "streamed-fe-deep-check",
+        "not supported on the streamed fixed-effect path",
+        ValueError,
+        _trigger_streamed_fe_deep_variance,
+    ),
+    (
+        "streamed-and-mesh",
+        "mesh-sharded coordinates are not composable yet",
+        ValueError,
+        _trigger_streamed_and_mesh,
+    ),
+    (
+        "full-variance-ceiling",
+        "exceeds the supported ceiling",
+        ValueError,
+        _trigger_full_variance_ceiling,
+    ),
+    (
+        "standardization-no-intercept",
+        "STANDARDIZATION requires an intercept term",
+        ValueError,
+        _trigger_standardization_no_intercept,
+    ),
+    (
+        "coo-on-mesh",
+        "shard_batch does not support the column-sorted COO layout",
+        NotImplementedError,
+        _trigger_coo_on_mesh,
+    ),
+    (
+        "multiprocess-ell",
+        "multi-process ELL sharding is not supported",
+        NotImplementedError,
+        _trigger_multiprocess_ell,
+    ),
+    (
+        "multiprocess-model-axis",
+        "model-axis sharding across processes is not supported yet",
+        NotImplementedError,
+        _trigger_multiprocess_model_axis,
+    ),
+]
+
+
+@pytest.mark.parametrize(
+    "fragment,exc,trigger", [c[1:] for c in CASES], ids=[c[0] for c in CASES]
+)
+def test_refusal_message_agrees_with_table(
+    fragment, exc, trigger, raw, readme_text, monkeypatch
+):
+    assert fragment in readme_text, (
+        "refusal message fragment missing from the README support-matrix "
+        f"ledger: {fragment!r}"
+    )
+    kwargs = (
+        {"monkeypatch": monkeypatch}
+        if "monkeypatch" in trigger.__code__.co_varnames
+        else {}
+    )
+    with pytest.raises(exc, match=re.escape(fragment)):
+        trigger(raw, **kwargs)
+
+
+def test_matrix_present_in_both_docs(readme_text, migration_text):
+    for text, doc in ((readme_text, "README.md"), (migration_text, "MIGRATION.md")):
+        assert "## Support matrix" in text, doc
+        # the two rows this PR added must be in the matrix, in both docs
+        assert "streamed FE row slices" in text, doc
+        assert "streamed RE entity slices" in text, doc
+
+
+def test_documented_ceiling_matches_code(readme_text):
+    # the README quotes the FULL-variance dim ceiling as a number; keep it
+    # equal to the single source of truth in ops/glm.py
+    assert f"d={MAX_FULL_VARIANCE_DIM}" in readme_text
