@@ -471,6 +471,157 @@ def bench_streamed_fe(n=200_000, d=1024, budget_mb=64, reg=1.0, max_iter=15):
     }
 
 
+def bench_serving(
+    duration_s=3.0,
+    n_clients=8,
+    d_fixed=1024,
+    n_users=20_000,
+    d_re=32,
+    unseen_frac=0.2,
+    max_batch=256,
+    max_latency_ms=2.0,
+):
+    """Resident scoring service on one chip: sustained scores/s and request
+    p99 at a fixed seen/unseen entity mix (cold-start requests fall back to
+    the fixed effect). ``n_clients`` closed-loop threads hammer the
+    microbatcher for ``duration_s`` after warmup; latency quantiles come
+    from the ``photon_serving_request_latency_seconds`` histogram the
+    service itself exports (the same numbers a production scrape would see).
+
+    value = sustained scores/s; vs_baseline = batched rate / sequential
+    single-request rate through the same engine (what microbatching buys
+    over a naive request-at-a-time server)."""
+    import tempfile
+    import threading
+
+    import jax.numpy as jnp
+
+    from photon_ml_tpu import obs, serving
+    from photon_ml_tpu.models.game import (
+        FixedEffectModel,
+        GameModel,
+        RandomEffectModel,
+    )
+    from photon_ml_tpu.models.glm import Coefficients, LogisticRegressionModel
+
+    rng = np.random.default_rng(0)
+    fe = FixedEffectModel(
+        model=LogisticRegressionModel(
+            Coefficients(jnp.asarray(rng.standard_normal(d_fixed) / np.sqrt(d_fixed)))
+        ),
+        feature_shard="globalShard",
+    )
+    support = 8
+    coef_idx = np.sort(
+        rng.integers(0, d_re, size=(n_users, support), dtype=np.int32), axis=1
+    )
+    re = RandomEffectModel(
+        random_effect_type="userId",
+        feature_shard="userShard",
+        task="logistic_regression",
+        entity_ids=np.asarray([f"u{i}" for i in range(n_users)], dtype=object),
+        coef_indices=jnp.asarray(coef_idx),
+        coef_values=jnp.asarray(rng.standard_normal((n_users, support)) * 0.3),
+    )
+    gm = GameModel(models={"global": fe, "per-user": re}, task="logistic_regression")
+
+    n_requests = 4096
+    nnz_fe, nnz_re = 16, 4
+    requests = []
+    for i in range(n_requests):
+        uid = (
+            f"u{rng.integers(0, n_users)}"
+            if rng.uniform() >= unseen_frac
+            else f"cold{i}"
+        )
+        requests.append(
+            serving.ScoreRequest(
+                features={
+                    "globalShard": (
+                        tuple(rng.integers(0, d_fixed, size=nnz_fe).tolist()),
+                        tuple(rng.standard_normal(nnz_fe).tolist()),
+                    ),
+                    "userShard": (
+                        tuple(rng.integers(0, d_re, size=nnz_re).tolist()),
+                        tuple(rng.standard_normal(nnz_re).tolist()),
+                    ),
+                },
+                ids={"userId": uid},
+            )
+        )
+
+    with tempfile.TemporaryDirectory() as tmp:
+        serving.build_store_from_model(gm, tmp)
+        store = serving.ModelStore.open(tmp)
+
+        # baseline: the same engine, one request per engine call (what a
+        # server without a microbatcher would sustain)
+        engine = serving.ScoreEngine.from_store(store)
+        engine.warm()
+        t0 = time.perf_counter()
+        n_seq = 0
+        while time.perf_counter() - t0 < min(duration_s, 1.0):
+            engine.score_requests([requests[n_seq % n_requests]])
+            n_seq += 1
+        seq_rate = n_seq / (time.perf_counter() - t0)
+
+        run = obs.RunTelemetry()
+        with obs.use_run(run):
+            server = serving.ScoringServer(
+                store=store, max_batch=max_batch, max_latency_ms=max_latency_ms
+            )
+            # warm the ladder rungs the clients will hit before the clock
+            server.submit(requests[0]).result(timeout=60.0)
+            stop_at = time.perf_counter() + duration_s
+            counts = [0] * n_clients
+
+            def client(k):
+                i = k
+                while time.perf_counter() < stop_at:
+                    server.submit(requests[i % n_requests]).result(timeout=60.0)
+                    counts[k] += 1
+                    i += n_clients
+
+            t0 = time.perf_counter()
+            threads = [
+                threading.Thread(target=client, args=(k,)) for k in range(n_clients)
+            ]
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join()
+            wall = time.perf_counter() - t0
+            server.close()
+
+        total = sum(counts)
+        lat = batch_mean = p50 = p99 = 0.0
+        cold = 0
+        for e in run.registry.snapshot():
+            if e["name"] == "photon_serving_request_latency_seconds":
+                p50 = obs.histogram_quantile(e["buckets"], e["count"], 0.5)
+                p99 = obs.histogram_quantile(e["buckets"], e["count"], 0.99)
+                lat = e["sum"] / max(e["count"], 1)
+            elif e["name"] == "photon_serving_batch_size":
+                batch_mean = e["sum"] / max(e["count"], 1)
+            elif e["name"] == "photon_serving_cold_start_total":
+                cold += int(e["value"])
+        rate = total / wall
+        return {
+            "metric": "serving_scores_per_sec_per_chip",
+            "value": round(rate, 1),
+            "unit": (
+                f"scores/sec sustained over {wall:.1f}s ({n_clients} closed-loop "
+                f"clients, {total} requests, {cold} cold-start fallbacks at "
+                f"{unseen_frac:.0%} unseen mix, n_users={n_users}; mean batch "
+                f"{batch_mean:.1f} under max_batch={max_batch}/"
+                f"max_latency={max_latency_ms}ms; latency mean {lat*1e3:.2f}ms "
+                f"p50 {p50*1e3:.2f}ms p99 {p99*1e3:.2f}ms; sequential "
+                f"single-request baseline {seq_rate:.0f}/s)"
+            ),
+            "vs_baseline": round(rate / max(seq_rate, 1e-9), 2),
+        }
+
+
 def bench_sparse_huge_d(n=200_000, d=10_000_000, k=32, lam=1.0, max_iter=20):
     """Huge-d sparse fixed effect: column-sorted COO layout, L-BFGS, vs a
     scipy.sparse CPU baseline at the same iteration budget.
@@ -751,7 +902,7 @@ def main():
     p = argparse.ArgumentParser()
     p.add_argument(
         "--config",
-        choices=["glmix", "sparse", "billion", "tiled", "hbm", "streamed-fe"],
+        choices=["glmix", "sparse", "billion", "tiled", "hbm", "streamed-fe", "serving"],
         default="glmix",
     )
     p.add_argument(
@@ -803,6 +954,9 @@ def main():
         return
     if a.config == "streamed-fe":
         print(json.dumps(bench_streamed_fe(n=min(a.n, 200_000))))
+        return
+    if a.config == "serving":
+        print(json.dumps(bench_serving()))
         return
 
     n = a.n

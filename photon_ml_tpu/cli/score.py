@@ -16,7 +16,6 @@ from typing import List, Optional
 
 import numpy as np
 
-from ..estimators.game_estimator import GameTransformer
 from ..io import read_avro_dataset
 from ..io.avro import write_avro_file
 from ..io.index_map import load_partitioned
@@ -114,13 +113,20 @@ def run(argv: Optional[List[str]] = None):
             f"model needs id tags {missing}; pass --id-tags {','.join(missing)}"
         )
 
-    transformer = GameTransformer(model=model)
+    # the same compiled score assembly the resident service keeps warm
+    # (serving/engine.py) — batch and resident scores are bitwise-identical
+    from ..serving.engine import ScoreEngine
+
     evaluators = [e for e in args.evaluators.split(",") if e]
     multiprocess = multihost.process_count() > 1
+    scores = ScoreEngine.from_model(model).score_dataset(raw)
+    evaluation = None
     # multi-process: score locally, evaluate globally below
-    scores, evaluation = transformer.transform(
-        raw, evaluator_specs=() if multiprocess else evaluators
-    )
+    if evaluators and not multiprocess:
+        from ..evaluation.suite import build_suite
+
+        suite = build_suite(evaluators, raw.labels, raw.weights, id_tags=raw.id_tags)
+        evaluation = suite.evaluate(scores)
 
     if multiprocess and evaluators:
         # global metrics need every host's (score, label, weight, tags):

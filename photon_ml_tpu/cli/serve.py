@@ -1,0 +1,149 @@
+"""Resident GLMix scoring service driver.
+
+The serving-side complement of the batch scorer (``cli/score.py``): open a
+published mmap snapshot (or publish one first from an Avro GAME model dir),
+keep the score kernels warm, microbatch requests, and flip to newly
+published snapshots without dropping traffic (see ``serving/``).
+
+Typical flow::
+
+    # one-time (and per retrain): flatten the Avro model into a snapshot
+    python -m photon_ml_tpu.cli.serve --serving-root out/serving \
+        --publish-model out/models/best --feature-index-dir out/index \
+        --snapshot-name v1 --publish-only
+
+    # resident server over an AF_UNIX socket
+    python -m photon_ml_tpu.cli.serve --serving-root out/serving \
+        --socket /tmp/photon-serve.sock --metrics-out out/serving-metrics
+"""
+
+from __future__ import annotations
+
+import argparse
+import logging
+import os
+import sys
+import threading
+from typing import List, Optional
+
+from ..io.index_map import load_partitioned
+from ..utils.logging import setup_logging
+
+logger = logging.getLogger("photon_ml_tpu")
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser("photon-ml-tpu resident scoring service")
+    p.add_argument(
+        "--serving-root",
+        default=None,
+        help="published-snapshot root (CURRENT + snapshots/); enables "
+        "zero-downtime refresh when new snapshots are published",
+    )
+    p.add_argument(
+        "--store-dir",
+        default=None,
+        help="serve one fixed mmap store directly (no refresh watching)",
+    )
+    p.add_argument(
+        "--publish-model",
+        default=None,
+        help="Avro GAME model dir to flatten + publish into --serving-root "
+        "before serving (requires --feature-index-dir)",
+    )
+    p.add_argument("--feature-index-dir", default=None)
+    p.add_argument("--snapshot-name", default="v1")
+    p.add_argument("--task", default=None, help="override model task type")
+    p.add_argument(
+        "--publish-only",
+        action="store_true",
+        help="publish the snapshot and exit without serving",
+    )
+    p.add_argument("--socket", default=None, help="AF_UNIX socket path to serve on")
+    p.add_argument("--max-batch", type=int, default=256)
+    p.add_argument("--max-latency-ms", type=float, default=2.0)
+    p.add_argument("--poll-seconds", type=float, default=0.2)
+    p.add_argument(
+        "--metrics-out",
+        default=None,
+        help="directory for the Prometheus exposition written on shutdown",
+    )
+    p.add_argument("--log-file", default=None)
+    p.add_argument("--log-level", default="INFO")
+    return p
+
+
+def run(argv: Optional[List[str]] = None, stop_event=None):
+    args = build_parser().parse_args(argv)
+    setup_logging(args.log_level, args.log_file)
+    from ..utils.compile_cache import enable_persistent_compilation_cache
+
+    enable_persistent_compilation_cache()
+
+    from .. import obs, serving
+
+    if args.publish_model:
+        if not args.serving_root:
+            raise SystemExit("--publish-model requires --serving-root")
+        if not args.feature_index_dir:
+            raise SystemExit("--publish-model requires --feature-index-dir")
+        shards = serving.discover_shards(args.publish_model)
+        index_maps = {
+            s: load_partitioned(args.feature_index_dir, s) for s in shards
+        }
+        path = serving.publish_snapshot(
+            args.serving_root,
+            args.snapshot_name,
+            model_dir=args.publish_model,
+            index_maps=index_maps,
+            task=args.task,
+        )
+        logger.info("published snapshot %s", path)
+        if args.publish_only:
+            return None
+
+    if bool(args.serving_root) == bool(args.store_dir):
+        raise SystemExit("pass exactly one of --serving-root / --store-dir")
+
+    run_ctx = obs.RunTelemetry()
+    if args.metrics_out:
+        os.makedirs(args.metrics_out, exist_ok=True)
+        run_ctx.register_listener(
+            obs.PrometheusSink(os.path.join(args.metrics_out, "metrics.prom"))
+        )
+    with obs.use_run(run_ctx):
+        if args.serving_root:
+            server = serving.ScoringServer(
+                serving_root=args.serving_root,
+                max_batch=args.max_batch,
+                max_latency_ms=args.max_latency_ms,
+                poll_seconds=args.poll_seconds,
+            )
+        else:
+            server = serving.ScoringServer(
+                store=serving.ModelStore.open(args.store_dir),
+                max_batch=args.max_batch,
+                max_latency_ms=args.max_latency_ms,
+            )
+        logger.info(
+            "serving snapshot %s (socket=%s)", server.snapshot_name, args.socket
+        )
+        try:
+            if args.socket:
+                serving.serve_socket(server, args.socket, stop_event=stop_event)
+            elif stop_event is not None:
+                stop_event.wait()
+            else:
+                threading.Event().wait()  # resident until killed
+        finally:
+            server.close()
+            run_ctx.close()  # final flush: the p50/p95/p99 exposition
+    return None
+
+
+def main():
+    run(sys.argv[1:])
+
+
+if __name__ == "__main__":
+    main()

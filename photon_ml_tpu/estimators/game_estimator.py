@@ -33,7 +33,7 @@ from ..game.data import (
 from ..game.descent import CoordinateDescent, ValidationContext
 from ..game.problem import GLMOptimizationConfig
 from ..io.data import RawDataset
-from ..models.game import FixedEffectModel, GameModel, RandomEffectModel
+from ..models.game import GameModel
 from ..ops.normalization import NormalizationContext
 from ..utils.events import (
     EventEmitter,
@@ -510,26 +510,12 @@ class GameTransformer:
     def transform(
         self, raw: RawDataset, evaluator_specs: Sequence[str] = ()
     ) -> Tuple[np.ndarray, Optional[EvaluationResults]]:
-        from ..game.data import _rows_to_ell
+        # one score assembly for the whole repo: the serving engine's compiled
+        # kernels (serving/engine.py), so batch and resident scoring cannot
+        # drift (tests/test_serving.py pins bitwise parity)
+        from ..serving.engine import ScoreEngine
 
-        total = np.asarray(raw.offsets, dtype=np.float64).copy()
-        for name, sub in self.model.models.items():
-            if isinstance(sub, FixedEffectModel):
-                batch = raw.to_batch(sub.feature_shard, dtype=self.dtype)
-                total += np.asarray(
-                    batch.features.matvec(sub.model.coefficients.means), dtype=np.float64
-                )
-            elif isinstance(sub, RandomEffectModel):
-                rows, cols, vals = raw.shard_coo[sub.feature_shard]
-                idx, val = _rows_to_ell(rows, cols, vals, raw.n_rows)
-                ids = raw.id_tags[sub.random_effect_type]
-                erow = jnp.asarray(sub.rows_for(ids).astype(np.int32))
-                total += np.asarray(
-                    sub.score_ell_rows(erow, jnp.asarray(idx), jnp.asarray(val, self.dtype)),
-                    dtype=np.float64,
-                )
-            else:
-                raise TypeError(f"unknown model type for {name}: {type(sub)}")
+        total = ScoreEngine.from_model(self.model, dtype=self.dtype).score_dataset(raw)
 
         evaluation = None
         if evaluator_specs:

@@ -19,7 +19,12 @@ nothing that would force a device fetch runs. `cli.train --metrics-out DIR`
 wires this up end to end.
 """
 
-from .metrics import DEFAULT_BUCKETS, MetricsRegistry, render_prometheus
+from .metrics import (
+    DEFAULT_BUCKETS,
+    MetricsRegistry,
+    histogram_quantile,
+    render_prometheus,
+)
 from .run import (
     MetricsSnapshotEvent,
     RunTelemetry,
@@ -60,6 +65,7 @@ __all__ = [
     "compile_seconds_total",
     "current_run",
     "current_span",
+    "histogram_quantile",
     "record_solver_metrics",
     "render_prometheus",
     "set_current_run",

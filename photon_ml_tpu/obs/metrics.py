@@ -311,10 +311,40 @@ class MetricsRegistry:
         return render_prometheus(self.snapshot())
 
 
+SERVING_QUANTILES: Tuple[float, ...] = (0.5, 0.95, 0.99)
+
+
+def histogram_quantile(
+    buckets: List, count: int, q: float
+) -> float:
+    """Estimate the ``q``-quantile from cumulative histogram buckets, the
+    way PromQL's ``histogram_quantile`` does: find the bucket holding the
+    target rank and interpolate linearly inside it (lower bound of the first
+    bucket is 0.0). A target landing in the +Inf bucket clamps to the
+    highest finite ``le`` — quantiles beyond the ladder are unknowable."""
+    if count <= 0 or not buckets:
+        return 0.0
+    target = q * count
+    lo = 0.0
+    lo_cum = 0
+    for le, cum in buckets:
+        if target <= cum:
+            in_bucket = cum - lo_cum
+            if in_bucket <= 0:
+                return float(le)
+            frac = (target - lo_cum) / in_bucket
+            return float(lo + (le - lo) * frac)
+        lo, lo_cum = le, cum
+    return float(buckets[-1][0])
+
+
 def render_prometheus(snapshot: List[Dict]) -> str:
     """Prometheus text exposition of a registry snapshot. Summaries render
     their moments as suffixed gauges (_mean/_stdev/_min/_max) alongside the
-    standard _count/_sum — there are no quantiles to expose."""
+    standard _count/_sum — there are no quantiles to expose. Serving-side
+    histograms (``photon_serving_*``) additionally render estimated
+    _p50/_p95/_p99 gauges so a latency SLO is readable without a PromQL
+    evaluator in front of the textfile."""
     by_name: Dict[str, List[Dict]] = {}
     for entry in snapshot:
         by_name.setdefault(entry["name"], []).append(entry)
@@ -336,6 +366,14 @@ def render_prometheus(snapshot: List[Dict]) -> str:
                 lines.append(f"{name}_bucket{_format_labels(inf_labels)} {e['count']}")
                 lines.append(f"{name}_sum{_format_labels(e['labels'])} {e['sum']:.10g}")
                 lines.append(f"{name}_count{_format_labels(e['labels'])} {e['count']}")
+            if name.startswith("photon_serving_"):
+                for q in SERVING_QUANTILES:
+                    suffix = f"p{int(q * 100)}"
+                    lines.append(f"# TYPE {name}_{suffix} gauge")
+                    for e in entries:
+                        v = histogram_quantile(e["buckets"], e["count"], q)
+                        lab = _format_labels(e["labels"])
+                        lines.append(f"{name}_{suffix}{lab} {v:.10g}")
         elif kind == "summary":
             lines.append(f"# TYPE {name} summary")
             for e in entries:

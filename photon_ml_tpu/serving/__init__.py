@@ -1,0 +1,50 @@
+"""Resident GLMix scoring service (the GameScoringDriver product surface,
+re-shaped for a long-lived TPU process).
+
+Pieces, composable or standalone:
+
+- ``store``   — mmap model store: open-not-parse startup, host RSS
+  independent of entity count.
+- ``engine``  — the one compiled score assembly, shared by batch scoring
+  (``cli.score`` / ``GameTransformer``) and the resident request path.
+- ``batcher`` — microbatching under a max-latency / max-batch policy.
+- ``refresh`` — atomic snapshot publication + zero-downtime flips.
+- ``server``  — the composed resident service (+ AF_UNIX JSON-lines front).
+"""
+
+from .batcher import SERVING_LATENCY_BUCKETS, MicroBatcher
+from .engine import LADDER_ROWS, LADDER_WIDTH, ScoreEngine, ScoreRequest
+from .refresh import (
+    RefreshWatcher,
+    current_snapshot,
+    open_current,
+    publish_snapshot,
+    snapshot_path,
+)
+from .server import ScoringServer, serve_socket
+from .store import (
+    ModelStore,
+    build_store,
+    build_store_from_model,
+    discover_shards,
+)
+
+__all__ = [
+    "SERVING_LATENCY_BUCKETS",
+    "MicroBatcher",
+    "LADDER_ROWS",
+    "LADDER_WIDTH",
+    "ScoreEngine",
+    "ScoreRequest",
+    "RefreshWatcher",
+    "current_snapshot",
+    "open_current",
+    "publish_snapshot",
+    "snapshot_path",
+    "ScoringServer",
+    "serve_socket",
+    "ModelStore",
+    "build_store",
+    "build_store_from_model",
+    "discover_shards",
+]

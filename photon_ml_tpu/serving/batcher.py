@@ -1,0 +1,131 @@
+"""Request microbatching for the resident scorer.
+
+Concurrent callers submit single requests; one worker thread drains them
+into batches under a max-latency / max-batch policy (the serving analogue of
+Spark's partition batching): the first request in a batch waits at most
+``max_latency_ms``, and a batch closes early at ``max_batch`` rows. Each
+batch is scored by ONE engine reference captured at drain time — the
+atomicity unit of a zero-downtime model flip: a refresh swaps the engine
+*between* batches, so no batch can mix coefficients from two snapshots.
+
+Every completed request lands in the obs layer:
+``photon_serving_request_latency_seconds`` (histogram, enqueue->result),
+``photon_serving_batch_size`` (histogram), ``photon_serving_requests_total``
+and ``photon_serving_request_errors_total`` (counters). The Prometheus
+exposition renders p50/p95/p99 for the ``photon_serving_*`` histograms.
+"""
+
+from __future__ import annotations
+
+import queue
+import threading
+import time
+from concurrent.futures import Future
+from typing import Callable, List, Optional, Tuple
+
+from .. import obs
+from .engine import ScoreEngine, ScoreRequest
+
+# Serving latencies are sub-millisecond to tens of ms — the seconds-scale
+# DEFAULT_BUCKETS would put every observation in the first bucket and make
+# the quantile estimates useless.
+SERVING_LATENCY_BUCKETS: Tuple[float, ...] = (
+    0.0001, 0.00025, 0.0005, 0.001, 0.0025, 0.005, 0.01, 0.025, 0.05,
+    0.1, 0.25, 1.0, 5.0,
+)
+
+
+class MicroBatcher:
+    """Queue + worker thread turning concurrent requests into engine calls."""
+
+    def __init__(
+        self,
+        engine_fn: Callable[[], ScoreEngine],
+        max_batch: int = 256,
+        max_latency_ms: float = 2.0,
+    ):
+        if max_batch < 1:
+            raise ValueError("max_batch must be >= 1")
+        self._engine_fn = engine_fn
+        self.max_batch = int(max_batch)
+        self.max_latency_s = float(max_latency_ms) / 1e3
+        self._q: "queue.SimpleQueue" = queue.SimpleQueue()
+        self._closed = threading.Event()
+        self._worker = threading.Thread(
+            target=self._run, name="photon-serving-batcher", daemon=True
+        )
+        self._worker.start()
+
+    # -- client side ---------------------------------------------------------
+
+    def submit(self, request: ScoreRequest) -> Future:
+        """Enqueue one request; the Future resolves to its float64 score."""
+        if self._closed.is_set():
+            raise RuntimeError("MicroBatcher is closed")
+        fut: Future = Future()
+        self._q.put((request, time.perf_counter(), fut))
+        return fut
+
+    def close(self, timeout: float = 5.0) -> None:
+        self._closed.set()
+        self._worker.join(timeout=timeout)
+
+    # -- worker side ---------------------------------------------------------
+
+    def _drain_batch(self) -> List[tuple]:
+        """Block for a first request, then fill until max_batch or the first
+        request's latency budget is spent."""
+        try:
+            first = self._q.get(timeout=0.05)
+        except queue.Empty:
+            return []
+        batch = [first]
+        deadline = first[1] + self.max_latency_s
+        while len(batch) < self.max_batch:
+            remaining = deadline - time.perf_counter()
+            if remaining <= 0:
+                break
+            try:
+                batch.append(self._q.get(timeout=remaining))
+            except queue.Empty:
+                break
+        return batch
+
+    def _run(self) -> None:
+        while not (self._closed.is_set() and self._q.empty()):
+            batch = self._drain_batch()
+            if not batch:
+                continue
+            # ONE engine per batch: the flip atomicity unit (see module doc)
+            engine = self._engine_fn()
+            reg = obs.current_run().registry
+            try:
+                scores = engine.score_requests([b[0] for b in batch])
+            except Exception as exc:
+                # the error propagates to every caller through its Future —
+                # counted, not swallowed
+                errors = reg.counter(
+                    "photon_serving_request_errors_total",
+                    "requests failed inside the score engine",
+                )
+                errors.inc(len(batch))
+                for _, _, fut in batch:
+                    fut.set_exception(exc)
+                continue
+            done = time.perf_counter()
+            lat = reg.histogram(
+                "photon_serving_request_latency_seconds",
+                "request latency, enqueue to scored",
+                buckets=SERVING_LATENCY_BUCKETS,
+            )
+            for i, (_, t0, fut) in enumerate(batch):
+                fut.set_result(float(scores[i]))
+                lat.observe(done - t0)
+            reg.counter(
+                "photon_serving_requests_total", "requests scored"
+            ).inc(len(batch))
+            reg.histogram(
+                "photon_serving_batch_size",
+                "rows per scored microbatch",
+                buckets=(1, 2, 4, 8, 16, 32, 64, 128, 256, 512, 1024),
+            ).observe(len(batch))

@@ -1,0 +1,144 @@
+"""Zero-downtime model refresh: atomic snapshot publication + a watcher
+that flips the live store mid-traffic.
+
+Publication layout (one serving root per deployed model)::
+
+    serving_root/
+      CURRENT                # text file: the live snapshot's name
+      snapshots/<name>/      # one mmap store each (serving.store layout)
+
+``publish_snapshot`` builds the store in a hidden temp directory, renames it
+into ``snapshots/<name>`` (one atomic directory rename), then rewrites
+``CURRENT`` through ``robust.atomic`` — the output-committer discipline: a
+reader either sees the old pointer or the new one, never a half-built store.
+
+``RefreshWatcher`` polls ``CURRENT``; on a change it opens the new store
+*beside* the live one and hands it to the server, which swaps a single
+engine reference between microbatches (see ``serving.batcher``) — requests
+in flight finish on the old snapshot, the next batch scores on the new one,
+and nothing ever blocks. That is the kill-and-keep-serving drill of ROADMAP
+item 2, exercised end to end in ``tests/test_serving.py``.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+from typing import Callable, Mapping, Optional
+
+from .. import obs
+from ..robust.atomic import atomic_write_text
+from ..robust.retry import io_call
+from .store import ModelStore, build_store, build_store_from_model
+
+CURRENT_POINTER = "CURRENT"
+SNAPSHOT_DIR = "snapshots"
+
+
+def snapshot_path(serving_root: str, name: str) -> str:
+    return os.path.join(serving_root, SNAPSHOT_DIR, name)
+
+
+def publish_snapshot(
+    serving_root: str,
+    name: str,
+    game_model=None,
+    model_dir: Optional[str] = None,
+    index_maps: Optional[Mapping[str, object]] = None,
+    task: Optional[str] = None,
+) -> str:
+    """Build ``name`` from either an in-memory GameModel or an Avro model
+    directory, publish it atomically, and point ``CURRENT`` at it."""
+    if (game_model is None) == (model_dir is None):
+        raise ValueError("pass exactly one of game_model / model_dir")
+    final = snapshot_path(serving_root, name)
+    if os.path.exists(final):
+        raise FileExistsError(f"snapshot already published: {final}")
+    tmp = os.path.join(serving_root, SNAPSHOT_DIR, f".tmp-{name}")
+    os.makedirs(os.path.dirname(final), exist_ok=True)
+    if game_model is not None:
+        build_store_from_model(game_model, tmp)
+    else:
+        build_store(model_dir, index_maps or {}, tmp, task=task)
+    os.rename(tmp, final)  # atomic directory publish
+    atomic_write_text(os.path.join(serving_root, CURRENT_POINTER), name + "\n")
+    return final
+
+
+def current_snapshot(serving_root: str) -> Optional[str]:
+    """The live snapshot's name, or None before the first publish."""
+    path = os.path.join(serving_root, CURRENT_POINTER)
+    if not os.path.exists(path):
+        return None
+
+    def _read():
+        with open(path) as f:
+            return f.read().strip()
+
+    name = io_call(_read, site="io.serving_store")
+    return name or None
+
+
+def open_current(serving_root: str):
+    """(name, ModelStore) for the live snapshot; raises if none published."""
+    name = current_snapshot(serving_root)
+    if name is None:
+        raise FileNotFoundError(
+            f"{serving_root}: no published snapshot (no {CURRENT_POINTER})"
+        )
+    return name, ModelStore.open(snapshot_path(serving_root, name))
+
+
+class RefreshWatcher:
+    """Background poller that loads newly published snapshots and hands them
+    to ``on_flip(name, store)``. Counted in ``photon_serving_refresh_total``;
+    a failed load leaves the live model serving and is counted via
+    ``obs.swallowed_error('serving.refresh')``."""
+
+    def __init__(
+        self,
+        serving_root: str,
+        on_flip: Callable[[str, ModelStore], None],
+        poll_seconds: float = 0.2,
+        live: Optional[str] = None,
+    ):
+        self.serving_root = serving_root
+        self._on_flip = on_flip
+        self.poll_seconds = float(poll_seconds)
+        self._live = live
+        self._stop = threading.Event()
+        self._thread = threading.Thread(
+            target=self._run, name="photon-serving-refresh", daemon=True
+        )
+        self._thread.start()
+
+    def stop(self, timeout: float = 5.0) -> None:
+        self._stop.set()
+        self._thread.join(timeout=timeout)
+
+    def poke(self) -> None:
+        """Check for a new snapshot now (tests; avoids poll-interval sleeps)."""
+        self._check()
+
+    def _check(self) -> None:
+        try:
+            name = current_snapshot(self.serving_root)
+            if name is None or name == self._live:
+                return
+            store = ModelStore.open(snapshot_path(self.serving_root, name))
+        except Exception:
+            # a torn/late publish must not take down serving: keep the live
+            # model, surface the failure in metrics, retry next poll
+            obs.swallowed_error("serving.refresh")
+            return
+        self._on_flip(name, store)
+        self._live = name
+        obs.current_run().registry.counter(
+            "photon_serving_refresh_total",
+            "model snapshots flipped in without downtime",
+        ).inc()
+
+    def _run(self) -> None:
+        while not self._stop.is_set():
+            self._check()
+            self._stop.wait(self.poll_seconds)

@@ -1,0 +1,159 @@
+"""The resident scoring service: store + engine + microbatcher + refresh,
+composed behind one `submit`/`score` surface.
+
+The server keeps exactly one live ``ScoreEngine``; the batcher captures that
+reference once per microbatch, and a ``RefreshWatcher`` flip replaces it with
+a single attribute assignment — the GIL makes the swap atomic, the per-batch
+capture makes it *clean*: every batch scores entirely on one snapshot.
+
+For processes that can't link the package, ``serve_socket`` exposes the same
+surface over an AF_UNIX socket speaking JSON lines::
+
+    -> {"features": {"shard": [[idx...], [val...]]}, "ids": {...}, "offset": 0.0}
+    <- {"score": 1.25}   |   {"error": "..."}
+
+one connection per client, one request per line, responses in order.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import socket
+import threading
+from typing import Optional
+
+import jax.numpy as jnp
+
+from .. import obs
+from .batcher import MicroBatcher
+from .engine import ScoreEngine, ScoreRequest
+from .refresh import RefreshWatcher, open_current
+from .store import ModelStore
+
+
+class ScoringServer:
+    """Resident scorer over a published serving root (or a fixed store/engine).
+
+    With ``serving_root`` the server opens the CURRENT snapshot and watches
+    for newly published ones, flipping without dropping requests; with a
+    bare ``store``/``engine`` it serves that model until closed."""
+
+    def __init__(
+        self,
+        store: Optional[ModelStore] = None,
+        engine: Optional[ScoreEngine] = None,
+        serving_root: Optional[str] = None,
+        max_batch: int = 256,
+        max_latency_ms: float = 2.0,
+        poll_seconds: float = 0.2,
+        dtype=jnp.float32,
+    ):
+        if sum(x is not None for x in (store, engine, serving_root)) != 1:
+            raise ValueError("pass exactly one of store / engine / serving_root")
+        self.dtype = dtype
+        self.snapshot_name: Optional[str] = None
+        self._lock = threading.Lock()
+        self._watcher: Optional[RefreshWatcher] = None
+        if serving_root is not None:
+            name, store = open_current(serving_root)
+            self._install(name, store)
+            self._watcher = RefreshWatcher(
+                serving_root, self._install, poll_seconds=poll_seconds, live=name
+            )
+        elif store is not None:
+            self._install(None, store)
+        else:
+            self._engine = engine
+        self._engine.warm()
+        self._batcher = MicroBatcher(
+            self._current_engine, max_batch=max_batch, max_latency_ms=max_latency_ms
+        )
+
+    # -- refresh flip ---------------------------------------------------------
+
+    def _install(self, name: Optional[str], store: ModelStore) -> None:
+        """Build the engine for a freshly opened store, then flip the live
+        reference in one assignment (warm first: the flip must not stall
+        in-flight traffic on a compile)."""
+        engine = ScoreEngine.from_store(store, dtype=self.dtype)
+        if getattr(self, "_batcher", None) is not None:
+            engine.warm()
+        with self._lock:
+            self._engine = engine
+            self.snapshot_name = name
+
+    def _current_engine(self) -> ScoreEngine:
+        with self._lock:
+            return self._engine
+
+    def poke_refresh(self) -> None:
+        """Force an immediate CURRENT check (tests; avoids poll sleeps)."""
+        if self._watcher is not None:
+            self._watcher.poke()
+
+    # -- scoring surface ------------------------------------------------------
+
+    def submit(self, request: ScoreRequest):
+        """Enqueue one request; returns a Future resolving to its score."""
+        return self._batcher.submit(request)
+
+    def score(self, request: ScoreRequest, timeout: float = 30.0) -> float:
+        """Blocking single-request score."""
+        return self._batcher.submit(request).result(timeout=timeout)
+
+    def close(self) -> None:
+        if self._watcher is not None:
+            self._watcher.stop()
+        self._batcher.close()
+
+
+def _handle_conn(server: ScoringServer, conn: socket.socket) -> None:
+    with conn, conn.makefile("rwb") as f:
+        for line in f:
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                msg = json.loads(line)
+                req = ScoreRequest(
+                    features={
+                        shard: (tuple(iv[0]), tuple(iv[1]))
+                        for shard, iv in msg.get("features", {}).items()
+                    },
+                    ids=msg.get("ids", {}),
+                    offset=float(msg.get("offset", 0.0)),
+                )
+                out = {"score": server.score(req)}
+            except Exception as exc:
+                obs.swallowed_error("serving.socket")
+                out = {"error": str(exc)}
+            f.write((json.dumps(out) + "\n").encode())
+            f.flush()
+
+
+def serve_socket(
+    server: ScoringServer,
+    path: str,
+    stop_event: Optional[threading.Event] = None,
+) -> None:
+    """Serve ``server`` over an AF_UNIX socket at ``path`` until
+    ``stop_event`` is set (runs forever without one). One thread per
+    connection; requests within a connection are answered in order."""
+    if os.path.exists(path):
+        os.unlink(path)
+    stop = stop_event or threading.Event()
+    with socket.socket(socket.AF_UNIX, socket.SOCK_STREAM) as sock:
+        sock.bind(path)
+        sock.listen()
+        sock.settimeout(0.2)
+        while not stop.is_set():
+            try:
+                conn, _ = sock.accept()
+            except socket.timeout:
+                continue
+            threading.Thread(
+                target=_handle_conn, args=(server, conn), daemon=True
+            ).start()
+    if os.path.exists(path):
+        os.unlink(path)

@@ -141,6 +141,25 @@ def _trigger_multiprocess_model_axis(raw, monkeypatch):
     )
 
 
+def _trigger_serving_width_ladder(raw):
+    from photon_ml_tpu.serving.engine import LADDER_WIDTH, _ladder_width
+
+    _ladder_width(LADDER_WIDTH[-1] + 1)
+
+
+def _trigger_serving_store_version(raw, tmp_path):
+    import json as _json
+
+    from photon_ml_tpu.serving.store import ModelStore
+
+    d = tmp_path / "store"
+    d.mkdir()
+    (d / "store-meta.json").write_text(
+        _json.dumps({"version": 99, "task": "x", "coordinates": []})
+    )
+    ModelStore.open(str(d))
+
+
 CASES = [
     # (id, documented message fragment, exception type, trigger)
     (
@@ -229,6 +248,18 @@ CASES = [
         NotImplementedError,
         _trigger_multiprocess_model_axis,
     ),
+    (
+        "serving-width-ladder",
+        "exceeds the serving engine's padded feature-width ladder",
+        ValueError,
+        _trigger_serving_width_ladder,
+    ),
+    (
+        "serving-store-version",
+        "unsupported serving store version",
+        ValueError,
+        _trigger_serving_store_version,
+    ),
 ]
 
 
@@ -236,17 +267,18 @@ CASES = [
     "fragment,exc,trigger", [c[1:] for c in CASES], ids=[c[0] for c in CASES]
 )
 def test_refusal_message_agrees_with_table(
-    fragment, exc, trigger, raw, readme_text, monkeypatch
+    fragment, exc, trigger, raw, readme_text, monkeypatch, tmp_path
 ):
     assert fragment in readme_text, (
         "refusal message fragment missing from the README support-matrix "
         f"ledger: {fragment!r}"
     )
-    kwargs = (
-        {"monkeypatch": monkeypatch}
-        if "monkeypatch" in trigger.__code__.co_varnames
-        else {}
-    )
+    available = {"monkeypatch": monkeypatch, "tmp_path": tmp_path}
+    kwargs = {
+        k: v
+        for k, v in available.items()
+        if k in trigger.__code__.co_varnames
+    }
     with pytest.raises(exc, match=re.escape(fragment)):
         trigger(raw, **kwargs)
 
