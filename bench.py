@@ -46,6 +46,7 @@ from __future__ import annotations
 import json
 import os
 import time
+from typing import List, Optional, Tuple
 
 import numpy as np
 
@@ -890,14 +891,144 @@ def summary_metric(path: str) -> dict:
     }
 
 
-def main():
-    import argparse
+# -- regression gate ----------------------------------------------------------
+#
+# bench.py --diff OLD.json NEW.json turns the BENCH_r*.json trajectory into an
+# enforced contract: per-quadrant deltas against a configurable tolerance,
+# exit 1 on regression / 0 on parity / 2 on unusable inputs (r04's ~15%
+# regression was caught by a human reading BASELINE.md; this is the machine).
 
-    from photon_ml_tpu.utils.compile_cache import (
-        enable_persistent_compilation_cache,
+
+def _diff_usage_error(message: str) -> "SystemExit":
+    """Unusable --diff inputs exit 2, distinct from exit 1 (regression)."""
+    import sys
+
+    print(message, file=sys.stderr)
+    return SystemExit(2)
+
+
+def load_bench_record(path: str) -> dict:
+    """One bench record from either shape on disk: a raw bench JSON line
+    ({"metric", "value", "unit", ...}) or the driver wrapper
+    ({"n", "cmd", "rc", "tail", "parsed": {...}}) the BENCH_r*.json files use.
+    Raises SystemExit(2) on unreadable/unrecognizable input."""
+    try:
+        with open(path) as f:
+            doc = json.load(f)
+    except (OSError, json.JSONDecodeError) as e:
+        raise _diff_usage_error(f"--diff: cannot read bench record {path!r}: {e}")
+    if isinstance(doc, dict) and isinstance(doc.get("parsed"), dict):
+        parsed = dict(doc["parsed"])
+        # quadrants live in the inner JSON line when the wrapper kept it
+        if "quadrants" not in parsed and isinstance(doc.get("tail"), str):
+            brace = doc["tail"].find('{"metric"')
+            if brace >= 0:
+                try:
+                    inner = json.loads(doc["tail"][brace:].splitlines()[0])
+                    parsed.setdefault("quadrants", inner.get("quadrants"))
+                except (json.JSONDecodeError, ValueError):
+                    pass  # wrapper tail was truncated mid-line; metric+value suffice
+        doc = parsed
+    if not isinstance(doc, dict) or "metric" not in doc or "value" not in doc:
+        raise _diff_usage_error(
+            f"--diff: {path!r} is not a bench record (need metric + value)"
+        )
+    return doc
+
+
+def _lower_is_better(name: str) -> bool:
+    """Direction of improvement from the series name: wall/latency seconds
+    regress upward, throughput (examples/sec, scores/sec, GB/s) downward."""
+    n = name.lower()
+    if "per_sec" in n or "/s" in n:
+        return False
+    return n.endswith("_sec") or n.endswith("_seconds") or "latency" in n or "wall" in n
+
+
+def _diff_one(name: str, old_v: float, new_v: float, tolerance: float) -> dict:
+    lower_better = _lower_is_better(name)
+    if old_v == 0:
+        delta = 0.0 if new_v == 0 else float("inf")
+    else:
+        delta = (new_v - old_v) / abs(old_v)
+    regressed = (delta < -tolerance) if not lower_better else (delta > tolerance)
+    return {
+        "name": name,
+        "old": old_v,
+        "new": new_v,
+        "delta_pct": round(100.0 * delta, 2),
+        "direction": "lower_is_better" if lower_better else "higher_is_better",
+        "regressed": regressed,
+    }
+
+
+def run_diff(old: dict, new: dict, tolerance: float = 0.1) -> Tuple[int, List[dict]]:
+    """Compare two bench records; returns (exit_code, per-series rows).
+    The headline value is compared when both records carry the same metric;
+    every shared ``quadrants`` entry is compared as ``*_sec`` (lower-better)."""
+    rows: List[dict] = []
+    if old["metric"] == new["metric"]:
+        rows.append(
+            _diff_one(old["metric"], float(old["value"]), float(new["value"]), tolerance)
+        )
+    else:
+        raise _diff_usage_error(
+            f"--diff: incomparable records ({old['metric']!r} vs {new['metric']!r})"
+        )
+    oq, nq = old.get("quadrants") or {}, new.get("quadrants") or {}
+    for side in sorted(set(oq) & set(nq)):
+        os_, ns_ = oq[side] or {}, nq[side] or {}
+        for key in sorted(set(os_) & set(ns_)):
+            o_v, n_v = os_[key], ns_[key]
+            if isinstance(o_v, (int, float)) and isinstance(n_v, (int, float)):
+                rows.append(
+                    _diff_one(f"quadrants.{side}.{key}", float(o_v), float(n_v), tolerance)
+                )
+    return (1 if any(r["regressed"] for r in rows) else 0), rows
+
+
+def _append_progress(path: str, rows: List[dict], tolerance: float, rc: int) -> None:
+    """Append ONE JSONL row (never truncates: the driver's own rows live in
+    the same file and must survive)."""
+    row = {
+        "ts": time.time(),
+        "type": "bench_diff",
+        "tolerance": tolerance,
+        "regressed": bool(rc),
+        "series": {r["name"]: {"old": r["old"], "new": r["new"],
+                               "delta_pct": r["delta_pct"]} for r in rows},
+    }
+    with open(path, "a", encoding="utf-8") as f:
+        f.write(json.dumps(row, sort_keys=True) + "\n")
+
+
+def run_diff_files(
+    old_path: str,
+    new_path: str,
+    tolerance: float = 0.1,
+    progress_out: Optional[str] = None,
+) -> int:
+    old, new = load_bench_record(old_path), load_bench_record(new_path)
+    rc, rows = run_diff(old, new, tolerance=tolerance)
+    for r in rows:
+        arrow = "REGRESSION" if r["regressed"] else "ok"
+        print(
+            f"{r['name']}: {r['old']:.6g} -> {r['new']:.6g} "
+            f"({r['delta_pct']:+.2f}%, {r['direction']}) [{arrow}]"
+        )
+    verdict = (
+        f"REGRESSION beyond {tolerance:.0%} tolerance"
+        if rc
+        else f"parity within {tolerance:.0%} tolerance"
     )
+    print(f"--diff: {verdict} ({len(rows)} series compared)")
+    if progress_out:
+        _append_progress(progress_out, rows, tolerance, rc)
+    return rc
 
-    enable_persistent_compilation_cache()
+
+def main(argv: Optional[List[str]] = None):
+    import argparse
 
     p = argparse.ArgumentParser()
     p.add_argument(
@@ -934,7 +1065,44 @@ def main():
         "summary (total wall, per-coordinate iteration stats) instead of "
         "running a benchmark or scraping training stdout",
     )
-    a = p.parse_args()
+    p.add_argument(
+        "--diff",
+        nargs=2,
+        metavar=("OLD.json", "NEW.json"),
+        default=None,
+        help="regression gate: compare two bench records (raw bench lines or "
+        "BENCH_r*.json driver wrappers), print per-quadrant deltas, exit 1 "
+        "on any regression beyond --tolerance, 0 on parity (no JAX is "
+        "initialized on this path)",
+    )
+    p.add_argument(
+        "--tolerance",
+        type=float,
+        default=0.1,
+        help="--diff regression tolerance as a fraction (default 0.1 = 10%%)",
+    )
+    p.add_argument(
+        "--progress-out",
+        default=None,
+        help="with --diff: append one JSONL row of the delta report here "
+        "(e.g. PROGRESS.jsonl; append-only)",
+    )
+    a = p.parse_args(argv)
+
+    if a.diff:
+        # pure-host path: no compile cache / JAX init for a file comparison
+        raise SystemExit(
+            run_diff_files(
+                a.diff[0], a.diff[1],
+                tolerance=a.tolerance, progress_out=a.progress_out,
+            )
+        )
+
+    from photon_ml_tpu.utils.compile_cache import (
+        enable_persistent_compilation_cache,
+    )
+
+    enable_persistent_compilation_cache()
 
     if a.read_summary:
         print(json.dumps(summary_metric(a.read_summary)))

@@ -36,6 +36,18 @@ DEFAULT_ATOMIC_WRITE_MODULES: Tuple[str, ...] = (
     "photon_ml_tpu/io/*",
     "photon_ml_tpu/robust/*",
 )
+# R7 (direct wall-clock timing) applies here: the modules whose sections must
+# appear on the sweep timeline — a bare perf_counter pair is a measurement
+# the profiler cannot attribute.
+DEFAULT_TIMING_STRICT_MODULES: Tuple[str, ...] = (
+    "photon_ml_tpu/game/descent.py",
+    "photon_ml_tpu/game/coordinate.py",
+    "photon_ml_tpu/game/streaming.py",
+    "photon_ml_tpu/game/fe_streaming.py",
+    "photon_ml_tpu/game/problem.py",
+    "photon_ml_tpu/optimize/*",
+    "photon_ml_tpu/serving/*",
+)
 
 
 def _match(relpath: str, patterns: Sequence[str]) -> bool:
@@ -58,6 +70,7 @@ class LintConfig:
     hot_loop_modules: Tuple[str, ...] = DEFAULT_HOT_LOOP_MODULES
     dtype_strict_modules: Tuple[str, ...] = DEFAULT_DTYPE_STRICT_MODULES
     atomic_write_modules: Tuple[str, ...] = DEFAULT_ATOMIC_WRITE_MODULES
+    timing_strict_modules: Tuple[str, ...] = DEFAULT_TIMING_STRICT_MODULES
     root: str = "."
 
     def is_hot(self, relpath: str) -> bool:
@@ -68,6 +81,9 @@ class LintConfig:
 
     def is_atomic_write(self, relpath: str) -> bool:
         return _match(relpath, self.atomic_write_modules)
+
+    def is_timing_strict(self, relpath: str) -> bool:
+        return _match(relpath, self.timing_strict_modules)
 
     def is_excluded(self, relpath: str) -> bool:
         return _match(relpath, self.exclude)

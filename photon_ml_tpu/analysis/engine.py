@@ -130,6 +130,7 @@ def analyze_source(
         hot=config.is_hot(relpath),
         dtype_strict=config.is_dtype_strict(relpath),
         atomic=config.is_atomic_write(relpath),
+        timing=config.is_timing_strict(relpath),
         rules=rules,
     )
     sup = _suppressions(source)

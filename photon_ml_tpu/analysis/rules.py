@@ -70,6 +70,7 @@ RULES: Dict[str, str] = {
     "R4": "swallowed exception (no re-raise, no obs counter)",
     "R5": "non-atomic file write in an atomic-write module",
     "R6": "NaN mishandling (== nan compare / uncounted isnan patch)",
+    "R7": "direct wall-clock timing in a timing-strict module (use obs.span/timed)",
 }
 
 # attributes whose value is host metadata, not an array: reading them off a
@@ -867,17 +868,52 @@ def _run_r6(mod: _Module, hot: bool, add: AddFn) -> None:
 # --------------------------------------------------------------------------
 
 
+# --------------------------------------------------------------------------
+# R7: direct wall-clock timing in timing-strict modules
+#
+# The timeline profiler (obs/timeline.py) can only attribute what flows
+# through spans. A bare time.time()/time.perf_counter() pair in a hot-loop
+# module measures something the timeline cannot see — the measurement is
+# invisible to phase attribution, Chrome-trace export, and the JSONL stream.
+# Route the section through obs.span(...) / utils.timed(...) and read the
+# span's duration_s instead. Cross-thread timestamp plumbing that cannot be
+# a span (e.g. enqueue stamps handed to another thread) suppresses with
+# # photon: ignore[R7].
+
+_TIMING_CALLS = {"time.time", "time.perf_counter", "time.monotonic"}
+
+
+def _run_r7(mod: _Module, add: AddFn) -> None:
+    aliases = mod.aliases
+    for node in ast.walk(mod.tree):
+        if not isinstance(node, ast.Call):
+            continue
+        canonical = _canon(_dotted(node.func), aliases)
+        if canonical in _TIMING_CALLS:
+            add(
+                node.lineno,
+                node.col_offset,
+                "R7",
+                f"direct {canonical}() timing in a timing-strict module is "
+                "invisible to the timeline profiler: wrap the section in "
+                "obs.span(...)/timed(...) and read span.duration_s (suppress "
+                "cross-thread timestamp plumbing with # photon: ignore[R7])",
+            )
+
+
 def run_rules(
     tree: ast.Module,
     *,
     hot: bool,
     dtype_strict: bool,
     atomic: bool = False,
+    timing: bool = False,
     rules: Optional[Sequence[str]] = None,
 ) -> List[RawFinding]:
     """All rule passes over one parsed module. ``hot`` enables R1;
     ``dtype_strict`` enables R3's jnp.array-without-dtype subrule;
-    ``atomic`` enables R5 (direct-write detection in persistence modules)."""
+    ``atomic`` enables R5 (direct-write detection in persistence modules);
+    ``timing`` enables R7 (wall-clock timing outside obs.span/timed)."""
     mod = _Module(tree)
     out: List[RawFinding] = []
     enabled = set(rules) if rules is not None else set(RULES)
@@ -901,5 +937,7 @@ def run_rules(
         _run_r5(mod, adder("R5"))
     if "R6" in enabled:
         _run_r6(mod, hot, adder("R6"))
+    if timing and "R7" in enabled:
+        _run_r7(mod, adder("R7"))
     out.sort(key=lambda f: (f.line, f.col, f.rule))
     return out

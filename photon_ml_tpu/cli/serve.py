@@ -68,6 +68,14 @@ def build_parser() -> argparse.ArgumentParser:
         default=None,
         help="directory for the Prometheus exposition written on shutdown",
     )
+    p.add_argument(
+        "--status-port",
+        type=int,
+        default=None,
+        help="serve live /metrics, /healthz and /statusz (request QPS, "
+        "latency p50/p95/p99, live snapshot name) on this port while "
+        "resident (0 = ephemeral port)",
+    )
     p.add_argument("--log-file", default=None)
     p.add_argument("--log-level", default="INFO")
     return p
@@ -118,16 +126,23 @@ def run(argv: Optional[List[str]] = None, stop_event=None):
                 max_batch=args.max_batch,
                 max_latency_ms=args.max_latency_ms,
                 poll_seconds=args.poll_seconds,
+                status_port=args.status_port,
             )
         else:
             server = serving.ScoringServer(
                 store=serving.ModelStore.open(args.store_dir),
                 max_batch=args.max_batch,
                 max_latency_ms=args.max_latency_ms,
+                status_port=args.status_port,
             )
         logger.info(
             "serving snapshot %s (socket=%s)", server.snapshot_name, args.socket
         )
+        if server.status_port is not None:
+            logger.info(
+                "introspection endpoints -> http://127.0.0.1:%d/{metrics,"
+                "healthz,statusz}", server.status_port,
+            )
         try:
             if args.socket:
                 serving.serve_socket(server, args.socket, stop_event=stop_event)

@@ -219,6 +219,21 @@ def build_parser() -> argparse.ArgumentParser:
         "run_summary.json (total wall time, per-coordinate iteration "
         "stats, convergence-reason histogram)",
     )
+    p.add_argument(
+        "--trace-out",
+        default=None,
+        help="write a Chrome-trace / Perfetto JSON timeline of the run here "
+        "(coordinator only); per-sweep phase attribution (stage/solve/"
+        "score/eval/checkpoint + overlap factor) lands in run_summary.json",
+    )
+    p.add_argument(
+        "--status-port",
+        type=int,
+        default=None,
+        help="serve live /metrics (Prometheus text), /healthz and /statusz "
+        "(JSON: current sweep/coordinate, accepted losses, rejection "
+        "counters) on this port while training (0 = ephemeral port)",
+    )
     return p
 
 
@@ -253,35 +268,58 @@ def run(argv: Optional[List[str]] = None) -> Dict:
             multihost.process_index(), multihost.process_count(),
             jax.local_device_count(), jax.device_count(),
         )
+        # stamp span/JSONL lane identity so merged multi-process telemetry
+        # stays attributable (obs cannot import jax to ask for itself)
+        obs.set_process_index(multihost.process_index())
 
     t_run0 = time.perf_counter()
     run_t = None
     prev_run = None
     metric_sinks = []
-    if args.metrics_out and multihost.is_coordinator():
+    recorder = None
+    status_server = None
+    telemetry_on = bool(
+        args.metrics_out or args.trace_out or args.status_port is not None
+    )
+    if telemetry_on and multihost.is_coordinator():
         from ..utils.compile_cache import install_compile_metrics_hook
 
-        os.makedirs(args.metrics_out, exist_ok=True)
         run_t = obs.RunTelemetry()
-        metric_sinks = [
-            obs.JsonlSink(os.path.join(args.metrics_out, "metrics.jsonl")),
-            obs.PrometheusSink(os.path.join(args.metrics_out, "metrics.prom")),
-        ]
+        if args.metrics_out:
+            os.makedirs(args.metrics_out, exist_ok=True)
+            metric_sinks = [
+                obs.JsonlSink(os.path.join(args.metrics_out, "metrics.jsonl")),
+                obs.PrometheusSink(
+                    os.path.join(args.metrics_out, "metrics.prom")
+                ),
+            ]
+        if args.trace_out:
+            recorder = obs.TimelineRecorder()
+            metric_sinks = metric_sinks + [recorder]
         for sink in metric_sinks:
             run_t.register_listener(sink)
         prev_run = obs.set_current_run(run_t)
         install_compile_metrics_hook()
-        logger.info("run telemetry -> %s", args.metrics_out)
+        if args.status_port is not None:
+            status_server = obs.IntrospectionServer(run_t, port=args.status_port)
+            logger.info(
+                "introspection endpoints -> http://127.0.0.1:%d/{metrics,"
+                "healthz,statusz}", status_server.port,
+            )
+        if args.metrics_out:
+            logger.info("run telemetry -> %s", args.metrics_out)
     try:
-        return _run_training(args, run_t, metric_sinks, t_run0)
+        return _run_training(args, run_t, metric_sinks, t_run0, recorder)
     finally:
+        if status_server is not None:
+            status_server.stop()
         if run_t is not None:
             # final flush: last metrics.jsonl line + the final metrics.prom
             run_t.close()
             obs.set_current_run(prev_run)
 
 
-def _run_training(args, run_t, metric_sinks, t_run0) -> Dict:
+def _run_training(args, run_t, metric_sinks, t_run0, recorder=None) -> Dict:
     shards = build_shard_configs(args)
     id_tags = [t for t in args.id_tags.split(",") if t]
     coord_specs = args.coordinate or [
@@ -533,10 +571,23 @@ def _run_training(args, run_t, metric_sinks, t_run0) -> Dict:
         )
         doc["task"] = summary["task"]
         doc["best"] = summary["best"]
-        atomic_write_json(
-            os.path.join(args.metrics_out, "run_summary.json"),
-            doc, indent=2, default=float,
+        if recorder is not None:
+            # drain the listener queue: the "train" span above has closed by
+            # here, so the timeline holds the whole run
+            doc["timeline"] = recorder.phase_attribution()
+            recorder.write_chrome_trace(args.trace_out)
+            logger.info("chrome trace -> %s (load at ui.perfetto.dev)",
+                        args.trace_out)
+        # --trace-out without --metrics-out still gets a run_summary.json
+        # (the phase attribution belongs with the trace): next to the trace
+        summary_dir = args.metrics_out or os.path.dirname(
+            os.path.abspath(args.trace_out or "")
         )
+        if args.metrics_out or args.trace_out:
+            atomic_write_json(
+                os.path.join(summary_dir, "run_summary.json"),
+                doc, indent=2, default=float,
+            )
     if not multihost.is_coordinator():
         # only process 0 writes outputs (the reference's driver-to-HDFS role)
         return summary

@@ -237,8 +237,20 @@ class CoordinateDescent:
                         own = scores.get(name)
                         residual = summed - own if own is not None else summed
 
+                        # current-position board for /statusz scrapes: cheap
+                        # host dict writes, live even with no sink registered
+                        obs.current_run().status.update(
+                            sweep=it,
+                            n_sweeps=self.n_iterations,
+                            coordinate=name,
+                            coordinate_index=idx,
+                        )
                         with obs.span("cd.coordinate", iteration=it, coordinate=name):
-                            with timed(f"cd iter {it} coordinate {name}: train"):
+                            with timed(
+                                f"cd iter {it} coordinate {name}: train",
+                                phase="solve",
+                                coordinate=name,
+                            ):
                                 model, solver_result = coordinate.train(
                                     residual, initial_model=models.get(name)
                                 )
@@ -265,7 +277,11 @@ class CoordinateDescent:
                                         obs.current_run().registry, name, tracker
                                     )
 
-                            with timed(f"cd iter {it} coordinate {name}: score"):
+                            with timed(
+                                f"cd iter {it} coordinate {name}: score",
+                                phase="score",
+                                coordinate=name,
+                            ):
                                 new_scores = coordinate.score(model)
                             if faults.active():
                                 # fault site coordinate.scores: the schedule
@@ -289,6 +305,12 @@ class CoordinateDescent:
                                 scores[name] = new_scores
                                 if train_loss is not None:
                                     train_losses[name] = train_loss
+                                    obs.current_run().status.update(
+                                        accepted_losses={
+                                            k: float(v)
+                                            for k, v in train_losses.items()
+                                        }
+                                    )
 
                                 if (
                                     self.validation is not None
@@ -312,7 +334,9 @@ class CoordinateDescent:
                             # reachable. Serialization fetches device arrays,
                             # so lift the transfer guard for exactly this call
                             # — a checkpoint is a deliberate sync point.
-                            with allow_transfers():
+                            with allow_transfers(), obs.span(
+                                "cd.checkpoint", phase="checkpoint", coordinate=name
+                            ):
                                 self.boundary_fn(
                                     CDBoundaryState(
                                         iteration=it,
@@ -337,7 +361,8 @@ class CoordinateDescent:
                 # model arrays however they like (np.asarray included), and a
                 # checkpoint is a deliberate pipeline sync point anyway
                 if self.checkpoint_fn is not None:
-                    self.checkpoint_fn(it, dict(models))
+                    with obs.span("cd.checkpoint", phase="checkpoint"):
+                        self.checkpoint_fn(it, dict(models))
             if obs.active():
                 # one metrics line per sweep in the JSONL stream
                 obs.current_run().flush_metrics()
@@ -397,7 +422,8 @@ class CoordinateDescent:
         )
 
     def _track_best(self, models, evaluations, best_eval, best_models, it, name):
-        res = self._evaluate(models)
+        with obs.span("cd.eval", phase="eval", iteration=it, coordinate=name):
+            res = self._evaluate(models)
         evaluations.append((name, res))
         primary = self.validation.suite.primary
         # only snapshots with every coordinate trained are candidates for

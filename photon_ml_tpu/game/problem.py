@@ -230,8 +230,6 @@ class GLMProblem:
         Breeze-on-the-driver + treeAggregate-per-evaluation split. Same
         normalization / warm-start / prior semantics as ``run``; returns a
         host-materialized SolverResult."""
-        import time as _time
-
         from ..optimize import host_optimize
         from .fe_streaming import StreamedFEObjective
 
@@ -276,19 +274,19 @@ class GLMProblem:
             prior_precision=prior_precision,
             residual_scores=residual_scores,
         )
-        t0 = _time.perf_counter()
         with obs.span(
             "fe_stream.solve",
+            phase="solve",
             n_slices=obj.n_slices,
             budget_bytes=int(budget_bytes),
-        ):
+        ) as solve_span:
             result = host_optimize(
                 obj.value_and_grad,
                 w0,
                 self.config.solver_config(),
                 hvp=obj.hessian_vector,
             )
-        obj.record_metrics("fe.train", _time.perf_counter() - t0)
+        obj.record_metrics("fe.train", solve_span.duration_s)
 
         means = jnp.asarray(result.coefficients, dtype)
         if self.normalization is not None:

@@ -19,6 +19,7 @@ nothing that would force a device fetch runs. `cli.train --metrics-out DIR`
 wires this up end to end.
 """
 
+from .http import IntrospectionServer, compose_statusz
 from .metrics import (
     DEFAULT_BUCKETS,
     MetricsRegistry,
@@ -28,6 +29,7 @@ from .metrics import (
 from .run import (
     MetricsSnapshotEvent,
     RunTelemetry,
+    StatusBoard,
     active,
     build_run_summary,
     current_run,
@@ -37,6 +39,7 @@ from .run import (
     use_run,
 )
 from .sinks import JsonlSink, PrometheusSink
+from .timeline import TimelineRecorder
 from .tracing import (
     Span,
     SpanEvent,
@@ -45,16 +48,21 @@ from .tracing import (
     add_device_put_bytes,
     compile_seconds_total,
     current_span,
+    get_process_index,
+    set_process_index,
     span,
 )
 
 __all__ = [
     "DEFAULT_BUCKETS",
+    "IntrospectionServer",
     "MetricsRegistry",
     "MetricsSnapshotEvent",
     "RunTelemetry",
     "Span",
     "SpanEvent",
+    "StatusBoard",
+    "TimelineRecorder",
     "JsonlSink",
     "PrometheusSink",
     "active",
@@ -63,12 +71,15 @@ __all__ = [
     "add_device_put_bytes",
     "build_run_summary",
     "compile_seconds_total",
+    "compose_statusz",
     "current_run",
     "current_span",
+    "get_process_index",
     "histogram_quantile",
     "record_solver_metrics",
     "render_prometheus",
     "set_current_run",
+    "set_process_index",
     "span",
     "swallowed_error",
     "use_run",

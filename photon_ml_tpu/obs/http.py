@@ -1,0 +1,176 @@
+"""Live introspection endpoints: /metrics, /healthz, /statusz.
+
+``IntrospectionServer`` is a stdlib-only threaded HTTP server bound to a
+``RunTelemetry``. Training (``cli train --status-port``) and serving
+(``cli serve --status-port``) both mount it, so a run can be scraped *while
+it is happening* instead of reading metric files after the fact:
+
+- ``/metrics``  — Prometheus text exposition rendered from the live registry
+- ``/healthz``  — liveness probe, ``{"status": "ok"}``
+- ``/statusz``  — JSON runtime status: current sweep / coordinate and
+  accepted losses (from the run's StatusBoard), rejection / divergence
+  counters and stream-slice progress (derived from the registry), and —
+  when serving metrics exist — request QPS and latency quantiles.
+
+All handlers read snapshots under the registry/board locks, never the live
+structures, so a scrape can never block or torn-read the training thread.
+"""
+
+from __future__ import annotations
+
+import http.server
+import json
+import threading
+import time
+from typing import Dict, Optional
+
+from .metrics import histogram_quantile
+from .run import RunTelemetry, current_run
+
+_QUANTILES = (0.5, 0.95, 0.99)
+
+
+def _sum_counter(snapshot, name: str, label: Optional[str] = None):
+    """Sum a counter family; with ``label``, return {label_value: sum}."""
+    if label is None:
+        total = 0.0
+        for m in snapshot:
+            if m["name"] == name and m["kind"] == "counter":
+                total += m["value"]
+        return total
+    out: Dict[str, float] = {}
+    for m in snapshot:
+        if m["name"] == name and m["kind"] == "counter":
+            key = str(m.get("labels", {}).get(label, ""))
+            out[key] = out.get(key, 0.0) + m["value"]
+    return out
+
+
+def compose_statusz(run: RunTelemetry, qps: Optional[float] = None) -> dict:
+    """Build the /statusz JSON document from a run's board + registry."""
+    snap = run.registry.snapshot()
+    doc: dict = {"status": "ok", "unix_time": time.time()}
+    doc.update(run.status.snapshot())
+
+    rejections = _sum_counter(snap, "photon_coordinate_rejections_total", "coordinate")
+    if rejections:
+        doc["coordinate_rejections"] = {k: int(v) for k, v in rejections.items()}
+    diverged = _sum_counter(snap, "photon_solver_diverged_lanes_total")
+    if diverged:
+        doc["diverged_lanes"] = int(diverged)
+    swallowed = _sum_counter(snap, "photon_swallowed_errors_total")
+    if swallowed:
+        doc["swallowed_errors"] = int(swallowed)
+
+    stream: dict = {}
+    slices = _sum_counter(snap, "photon_stream_slices_total")
+    if slices:
+        stream["slices_staged"] = int(slices)
+        stream["staged_bytes"] = int(
+            _sum_counter(snap, "photon_stream_staged_bytes_total")
+        )
+    if stream:
+        doc["stream"] = stream
+
+    serving: dict = {}
+    requests = _sum_counter(snap, "photon_serving_requests_total")
+    if requests:
+        serving["requests_total"] = int(requests)
+        serving["errors_total"] = int(
+            _sum_counter(snap, "photon_serving_request_errors_total")
+        )
+        if qps is not None:
+            serving["qps"] = qps
+    for m in snap:
+        if m["name"] == "photon_serving_request_latency_seconds" and m["kind"] == "histogram":
+            for q in _QUANTILES:
+                serving[f"latency_p{int(q * 100)}_seconds"] = histogram_quantile(
+                    m["buckets"], m["count"], q
+                )
+            break
+    if serving:
+        doc["serving"] = serving
+    return doc
+
+
+class IntrospectionServer:
+    """Threaded HTTP server exposing /metrics, /healthz and /statusz for one
+    ``RunTelemetry``. ``port=0`` binds an ephemeral port; the bound port is
+    available as ``.port`` (tests and log lines use it)."""
+
+    def __init__(
+        self,
+        run: Optional[RunTelemetry] = None,
+        port: int = 0,
+        host: str = "127.0.0.1",
+    ) -> None:
+        self._run = run
+        self._qps_lock = threading.Lock()
+        self._qps_state: Optional[tuple] = None  # (monotonic, requests_total)
+        server = self
+
+        class Handler(http.server.BaseHTTPRequestHandler):
+            def do_GET(self) -> None:
+                path = self.path.split("?", 1)[0]
+                if path == "/metrics":
+                    body = server._render_metrics().encode("utf-8")
+                    ctype = "text/plain; version=0.0.4; charset=utf-8"
+                elif path == "/healthz":
+                    body = json.dumps({"status": "ok"}).encode("utf-8")
+                    ctype = "application/json"
+                elif path == "/statusz":
+                    body = json.dumps(
+                        server.statusz(), default=str, sort_keys=True
+                    ).encode("utf-8")
+                    ctype = "application/json"
+                else:
+                    self.send_error(404, "unknown endpoint")
+                    return
+                self.send_response(200)
+                self.send_header("Content-Type", ctype)
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def log_message(self, fmt, *args) -> None:  # quiet by design
+                pass
+
+        self._httpd = http.server.ThreadingHTTPServer((host, port), Handler)
+        self._httpd.daemon_threads = True
+        self.host = host
+        self.port = int(self._httpd.server_address[1])
+        self._thread = threading.Thread(
+            target=self._httpd.serve_forever,
+            name=f"photon-introspection-{self.port}",
+            daemon=True,
+        )
+        self._thread.start()
+
+    def run(self) -> RunTelemetry:
+        return self._run if self._run is not None else current_run()
+
+    def _render_metrics(self) -> str:
+        return self.run().registry.to_prometheus()
+
+    def statusz(self) -> dict:
+        run = self.run()
+        qps = self._update_qps(run)
+        return compose_statusz(run, qps=qps)
+
+    def _update_qps(self, run: RunTelemetry) -> Optional[float]:
+        """Serving QPS from the requests_total delta between scrapes."""
+        total = _sum_counter(
+            run.registry.snapshot(), "photon_serving_requests_total"
+        )
+        now = time.monotonic()
+        with self._qps_lock:
+            prev = self._qps_state
+            self._qps_state = (now, total)
+        if prev is None or now <= prev[0]:
+            return None
+        return max(0.0, (total - prev[1]) / (now - prev[0]))
+
+    def stop(self) -> None:
+        self._httpd.shutdown()
+        self._httpd.server_close()
+        self._thread.join(timeout=5)

@@ -295,7 +295,12 @@ class MetricsRegistry:
             families = list(self._families.values())
         for fam in families:
             for child in fam.children():
-                entry = {"name": fam.name, "kind": fam.kind, "labels": child.labels_dict}
+                entry = {
+                    "name": fam.name,
+                    "kind": fam.kind,
+                    "help": fam.help,
+                    "labels": child.labels_dict,
+                }
                 if isinstance(child, (Counter, Gauge)):
                     entry["value"] = child.value
                 elif isinstance(child, Histogram):
@@ -311,7 +316,9 @@ class MetricsRegistry:
         return render_prometheus(self.snapshot())
 
 
-SERVING_QUANTILES: Tuple[float, ...] = (0.5, 0.95, 0.99)
+QUANTILES: Tuple[float, ...] = (0.5, 0.95, 0.99)
+# historical name from PR 6, when only photon_serving_* rendered quantiles
+SERVING_QUANTILES = QUANTILES
 
 
 def histogram_quantile(
@@ -338,13 +345,18 @@ def histogram_quantile(
     return float(buckets[-1][0])
 
 
+def _escape_help(text: str) -> str:
+    # HELP text escaping per the exposition format: backslash, then newline
+    return text.replace("\\", "\\\\").replace("\n", "\\n")
+
+
 def render_prometheus(snapshot: List[Dict]) -> str:
     """Prometheus text exposition of a registry snapshot. Summaries render
     their moments as suffixed gauges (_mean/_stdev/_min/_max) alongside the
-    standard _count/_sum — there are no quantiles to expose. Serving-side
-    histograms (``photon_serving_*``) additionally render estimated
-    _p50/_p95/_p99 gauges so a latency SLO is readable without a PromQL
-    evaluator in front of the textfile."""
+    standard _count/_sum — there are no quantiles to expose. Every histogram
+    additionally renders estimated _p50/_p95/_p99 gauges (serving latency,
+    stream staging, checkpoint timings) so latency/duration SLOs are readable
+    without a PromQL evaluator in front of the textfile."""
     by_name: Dict[str, List[Dict]] = {}
     for entry in snapshot:
         by_name.setdefault(entry["name"], []).append(entry)
@@ -352,6 +364,9 @@ def render_prometheus(snapshot: List[Dict]) -> str:
     for name in sorted(by_name):
         entries = by_name[name]
         kind = entries[0]["kind"]
+        help_text = entries[0].get("help", "")
+        if help_text:
+            lines.append(f"# HELP {name} {_escape_help(help_text)}")
         if kind in ("counter", "gauge"):
             lines.append(f"# TYPE {name} {kind}")
             for e in entries:
@@ -366,14 +381,13 @@ def render_prometheus(snapshot: List[Dict]) -> str:
                 lines.append(f"{name}_bucket{_format_labels(inf_labels)} {e['count']}")
                 lines.append(f"{name}_sum{_format_labels(e['labels'])} {e['sum']:.10g}")
                 lines.append(f"{name}_count{_format_labels(e['labels'])} {e['count']}")
-            if name.startswith("photon_serving_"):
-                for q in SERVING_QUANTILES:
-                    suffix = f"p{int(q * 100)}"
-                    lines.append(f"# TYPE {name}_{suffix} gauge")
-                    for e in entries:
-                        v = histogram_quantile(e["buckets"], e["count"], q)
-                        lab = _format_labels(e["labels"])
-                        lines.append(f"{name}_{suffix}{lab} {v:.10g}")
+            for q in QUANTILES:
+                suffix = f"p{int(q * 100)}"
+                lines.append(f"# TYPE {name}_{suffix} gauge")
+                for e in entries:
+                    v = histogram_quantile(e["buckets"], e["count"], q)
+                    lab = _format_labels(e["labels"])
+                    lines.append(f"{name}_{suffix}{lab} {v:.10g}")
         elif kind == "summary":
             lines.append(f"# TYPE {name} summary")
             for e in entries:

@@ -15,10 +15,32 @@ from __future__ import annotations
 
 import contextlib
 import dataclasses
-from typing import List, Optional
+import threading
+from typing import Dict, List, Optional
 
 from ..utils.events import Event, EventEmitter, EventListener
 from .metrics import MetricsRegistry
+
+
+class StatusBoard:
+    """Thread-safe key/value board holding the run's *current position*
+    (sweep, coordinate, accepted losses, ...) for the ``/statusz`` endpoint.
+
+    Updates are cheap host-only dict writes, so instrumentation sites update
+    it unconditionally — it works on passive runs too, and a scrape thread
+    can snapshot it while the training thread is mid-sweep."""
+
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        self._state: Dict[str, object] = {}
+
+    def update(self, **kv) -> None:
+        with self._lock:
+            self._state.update(kv)
+
+    def snapshot(self) -> Dict[str, object]:
+        with self._lock:
+            return dict(self._state)
 
 
 @dataclasses.dataclass(frozen=True)
@@ -37,6 +59,7 @@ class RunTelemetry(EventEmitter):
     def __init__(self, registry: Optional[MetricsRegistry] = None):
         super().__init__()
         self.registry = registry if registry is not None else MetricsRegistry()
+        self.status = StatusBoard()
 
     def flush_metrics(self) -> List[dict]:
         snap = self.registry.snapshot()

@@ -27,6 +27,7 @@ from __future__ import annotations
 import dataclasses
 import json
 import os
+import socket
 import threading
 from typing import Optional
 
@@ -34,7 +35,9 @@ from ..robust.atomic import atomic_write
 from ..utils.events import EventListener
 from .metrics import render_prometheus
 from .run import MetricsSnapshotEvent
-from .tracing import SpanEvent
+from .tracing import SpanEvent, get_process_index
+
+_HOSTNAME = socket.gethostname()
 
 
 def _json_placeholder(obj) -> str:
@@ -90,26 +93,32 @@ class JsonlSink(EventListener):
 
     @staticmethod
     def _payload(event) -> dict:
+        # every line carries host/process identity so JSONL streams from a
+        # multi-process run can be merged and stay attributable; read at
+        # write time, robust to set_process_index landing after sink setup
+        header = {"process_index": get_process_index(), "host": _HOSTNAME}
         if isinstance(event, SpanEvent):
             s = event.span
             return {
                 "type": "span",
+                **header,
                 "name": s.name,
                 "span_id": s.span_id,
                 "parent_id": s.parent_id,
                 "start_unix": s.start_unix,
                 "duration_s": s.duration_s,
+                "thread_id": s.thread_id,
                 "attrs": s.attrs,
             }
         if isinstance(event, MetricsSnapshotEvent):
-            return {"type": "metrics", "metrics": event.metrics}
+            return {"type": "metrics", **header, "metrics": event.metrics}
         body = {}
         if dataclasses.is_dataclass(event):
             # shallow on purpose: OptimizationLogEvent holds trackers whose
             # solver results are device arrays — recursing would fetch them
             for f in dataclasses.fields(event):
                 body[f.name] = getattr(event, f.name)
-        return {"type": "event", "event": type(event).__name__, **body}
+        return {"type": "event", **header, "event": type(event).__name__, **body}
 
     def close(self) -> None:
         with self._lock:

@@ -38,6 +38,20 @@ _ids = itertools.count(1)
 _compile_lock = threading.Lock()
 _compile_seconds_total = 0.0
 
+# Lane identity for multi-process timelines. obs must stay importable without
+# jax, so the process index is pushed in from outside (cli.train stamps it
+# from parallel.multihost after distributed init); single-process runs keep 0.
+_process_index = 0
+
+
+def set_process_index(index: int) -> None:
+    global _process_index
+    _process_index = int(index)
+
+
+def get_process_index() -> int:
+    return _process_index
+
 
 def add_compile_seconds(seconds: float) -> None:
     global _compile_seconds_total
@@ -58,6 +72,13 @@ class Span:
     start_unix: float
     attrs: Dict[str, object]
     duration_s: Optional[float] = None
+    # lane identity: which OS thread and which jax process ran this span
+    thread_id: int = 0
+    thread_name: str = ""
+    process_index: int = 0
+    # monotonic start (same clock as duration_s) — what the timeline
+    # profiler aligns intervals on; start_unix is for humans and merging
+    start_perf: float = 0.0
 
 
 @dataclasses.dataclass(frozen=True)
@@ -79,10 +100,14 @@ def span(name: str, **attrs):
         parent_id=parent.span_id if parent is not None else None,
         start_unix=time.time(),
         attrs=dict(attrs),
+        thread_id=threading.get_ident(),
+        thread_name=threading.current_thread().name,
+        process_index=_process_index,
     )
     token = _ctx.set(s)
     compile0 = compile_seconds_total()
     t0 = time.perf_counter()
+    s.start_perf = t0
     try:
         yield s
     finally:

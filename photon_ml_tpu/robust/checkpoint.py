@@ -196,6 +196,7 @@ class CheckpointManager:
             site="checkpoint.manifest",
         )
         self._seq += 1
+        save_seconds = time.perf_counter() - t0
         reg = _registry()
         reg.counter(
             "photon_checkpoint_saves_total", "boundary checkpoints written"
@@ -203,11 +204,14 @@ class CheckpointManager:
         reg.counter(
             "photon_checkpoint_bytes_total", "checkpoint payload bytes written"
         ).inc(len(blob))
+        reg.histogram(
+            "photon_checkpoint_save_seconds", "wall per boundary checkpoint save"
+        ).observe(save_seconds)
         self._rotate()
         logger.info(
             "checkpoint %s: iter %d coordinate %s (%d bytes, %.3fs)",
             name, payload["iteration"], payload["coordinate"], len(blob),
-            time.perf_counter() - t0,
+            save_seconds,
         )
         return ckpt_dir
 

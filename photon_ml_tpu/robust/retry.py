@@ -33,16 +33,21 @@ from typing import Callable, List, Tuple, Type
 logger = logging.getLogger("photon_ml_tpu")
 
 
-def _count_retry(site: str) -> None:
+def _count_retry(site: str, delay: float) -> None:
     # lazy import: robust sits below obs consumers but obs itself imports
     # nothing from robust, so this is only about avoiding a module-level
     # dependency for callers that never retry
     from .. import obs
 
-    obs.current_run().registry.counter(
+    reg = obs.current_run().registry
+    reg.counter(
         "photon_retry_attempts_total",
         "IO attempts that failed and were retried, by site",
     ).labels(site=site).inc()
+    reg.histogram(
+        "photon_retry_backoff_seconds",
+        "backoff slept before an IO retry, by site",
+    ).labels(site=site).observe(delay)
 
 
 @dataclasses.dataclass(frozen=True)
@@ -95,7 +100,7 @@ class RetryPolicy:
             except self.retryable as e:
                 if attempt == self.max_attempts - 1:
                     raise
-                _count_retry(site)
+                _count_retry(site, delays[attempt])
                 logger.warning(
                     "retryable failure at %s (attempt %d/%d): %s; retrying "
                     "in %.3fs",

@@ -48,6 +48,7 @@ class ScoringServer:
         max_latency_ms: float = 2.0,
         poll_seconds: float = 0.2,
         dtype=jnp.float32,
+        status_port: Optional[int] = None,
     ):
         if sum(x is not None for x in (store, engine, serving_root)) != 1:
             raise ValueError("pass exactly one of store / engine / serving_root")
@@ -55,6 +56,7 @@ class ScoringServer:
         self.snapshot_name: Optional[str] = None
         self._lock = threading.Lock()
         self._watcher: Optional[RefreshWatcher] = None
+        self._status_server = None
         if serving_root is not None:
             name, store = open_current(serving_root)
             self._install(name, store)
@@ -69,6 +71,23 @@ class ScoringServer:
         self._batcher = MicroBatcher(
             self._current_engine, max_batch=max_batch, max_latency_ms=max_latency_ms
         )
+        if status_port is not None:
+            # live scrape surface (metrics otherwise only flush to files at
+            # close): /metrics text exposition, /healthz, /statusz with
+            # request QPS + latency quantiles. Bound to the run current at
+            # construction — the one the batcher records into.
+            self._status_server = obs.IntrospectionServer(
+                obs.current_run(), port=status_port
+            )
+            # advertise the live snapshot on /statusz
+            obs.current_run().status.update(
+                serving_snapshot=self.snapshot_name
+            )
+
+    @property
+    def status_port(self) -> Optional[int]:
+        """Bound introspection port (useful with ``status_port=0``)."""
+        return None if self._status_server is None else self._status_server.port
 
     # -- refresh flip ---------------------------------------------------------
 
@@ -82,6 +101,8 @@ class ScoringServer:
         with self._lock:
             self._engine = engine
             self.snapshot_name = name
+        if getattr(self, "_status_server", None) is not None:
+            obs.current_run().status.update(serving_snapshot=name)
 
     def _current_engine(self) -> ScoreEngine:
         with self._lock:
@@ -105,6 +126,8 @@ class ScoringServer:
     def close(self) -> None:
         if self._watcher is not None:
             self._watcher.stop()
+        if self._status_server is not None:
+            self._status_server.stop()
         self._batcher.close()
 
 

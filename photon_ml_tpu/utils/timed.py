@@ -19,10 +19,12 @@ logger = logging.getLogger("photon_ml_tpu")
 
 
 @contextlib.contextmanager
-def timed(name: str, level: int = logging.DEBUG):
+def timed(name: str, level: int = logging.DEBUG, **attrs):
+    """Log the section's wall time; extra kwargs become span attributes
+    (e.g. ``phase=`` for the timeline profiler's phase attribution)."""
     t0 = time.perf_counter()
     try:
-        with span(name):
+        with span(name, **attrs):
             yield
     finally:
         logger.log(level, "%s took %.3fs", name, time.perf_counter() - t0)

@@ -455,6 +455,71 @@ def test_r6_suppressed_inline():
     assert [f.rule for f in fs if f.suppressed] == ["R6"]
 
 
+# ---------------------------------------------------------------- R7
+
+TIMING = "photon_ml_tpu/game/problem.py"  # timing-strict but not hot
+
+R7_SRC = """
+    import time
+
+    def solve():
+        t0 = time.perf_counter()
+        work()
+        return time.perf_counter() - t0
+    """
+
+
+def test_r7_fires_in_timing_strict_module():
+    fs = findings(R7_SRC, TIMING)
+    assert rules_of(fs) == ["R7", "R7"]
+    assert "obs.span" in fs[0].message
+
+
+def test_r7_silent_outside_timing_strict_modules():
+    assert rules_of(findings(R7_SRC, COLD)) == []
+
+
+def test_r7_catches_aliased_imports():
+    src = """
+    import time as _time
+    from time import perf_counter
+
+    def solve():
+        a = _time.time()
+        b = perf_counter()
+        c = _time.monotonic()
+    """
+    assert rules_of(findings(src, TIMING)) == ["R7", "R7", "R7"]
+
+
+def test_r7_span_and_timed_clean():
+    src = """
+    from photon_ml_tpu import obs
+    from photon_ml_tpu.utils.timed import timed
+
+    def solve():
+        with obs.span("solve", phase="solve") as sp:
+            work()
+        with timed("score"):
+            work()
+        return sp.duration_s
+    """
+    assert rules_of(findings(src, TIMING)) == []
+
+
+def test_r7_suppressed_inline():
+    src = """
+    import time
+
+    def submit(q, req):
+        # photon: ignore[R7] — cross-thread enqueue stamp, cannot be a span
+        q.put((req, time.perf_counter()))
+    """
+    fs = findings(src, TIMING)
+    assert rules_of(fs) == []
+    assert [f.rule for f in fs if f.suppressed] == ["R7"]
+
+
 # ----------------------------------------------------- suppression mechanics
 
 

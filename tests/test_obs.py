@@ -105,6 +105,57 @@ def test_render_prometheus_escaping_and_shapes():
     assert "iters_mean 3" in text
 
 
+def test_render_prometheus_help_lines_and_hostile_labels():
+    """Exposition grammar: # HELP precedes # TYPE per family, help text
+    escapes backslash-then-newline, and hostile label values (quotes,
+    backslashes, newlines, unicode) survive the escaping round trip."""
+    reg = MetricsRegistry()
+    reg.histogram(
+        "train_lat", "help with\nnewline and \\ backslash", buckets=(0.1, 1.0)
+    ).observe(0.5)
+    hostile = 'per"user\\x\ny\tzé'
+    reg.counter("c_total", "counts").labels(coordinate=hostile).inc()
+    text = render_prometheus(reg.snapshot())
+    lines = text.splitlines()
+    assert "# HELP c_total counts" in lines
+    assert lines.index("# HELP c_total counts") + 1 == lines.index(
+        "# TYPE c_total counter"
+    )
+    # help escaping: \ -> \\ first, then newline -> \n (no raw newlines)
+    assert "# HELP train_lat help with\\nnewline and \\\\ backslash" in lines
+    assert 'c_total{coordinate="per\\"user\\\\x\\ny\tzé"} 1' in lines
+    # exposition grammar: every non-comment line is `name{labels} value`
+    # with no unescaped newline inside a label value
+    for line in lines:
+        if not line or line.startswith("#"):
+            continue
+        name = line.split("{")[0].split(" ")[0]
+        assert name and name[0].isalpha()
+        assert line.count(" ") >= 1
+
+
+def test_quantile_gauges_for_all_histograms():
+    """p50/p95/p99 gauges render for every histogram family, not just
+    photon_serving_*."""
+    reg = MetricsRegistry()
+    h = reg.histogram(
+        "photon_stream_slice_stage_seconds", "stage wall", buckets=(0.1, 1.0, 10.0)
+    )
+    for v in (0.05, 0.5, 0.5, 5.0):
+        h.observe(v)
+    text = render_prometheus(reg.snapshot())
+    assert "# TYPE photon_stream_slice_stage_seconds_p50 gauge" in text
+    assert "photon_stream_slice_stage_seconds_p95" in text
+    assert "photon_stream_slice_stage_seconds_p99" in text
+    # p50 falls in the (0.1, 1.0] bucket under linear interpolation
+    p50 = [
+        float(l.split()[-1])
+        for l in text.splitlines()
+        if l.startswith("photon_stream_slice_stage_seconds_p50 ")
+    ][0]
+    assert 0.1 < p50 <= 1.0
+
+
 # ------------------------------------------------------------ spans/tracing
 
 
@@ -205,9 +256,36 @@ def test_jsonl_sink_schema(tmp_path):
     # one explicit flush plus the final flush from close()
     mlines = [l for l in lines if l["type"] == "metrics"]
     assert mlines
-    assert {"name": "c_total", "kind": "counter", "labels": {}, "value": 1} in mlines[
-        0
-    ]["metrics"]
+    assert {
+        "name": "c_total", "kind": "counter", "help": "", "labels": {}, "value": 1
+    } in mlines[0]["metrics"]
+
+
+def test_jsonl_sink_stamps_host_identity(tmp_path):
+    """Every JSONL event carries process_index + host so multi-host streams
+    can be merged without guessing which process wrote which line."""
+    import socket
+
+    path = str(tmp_path / "m.jsonl")
+    run = obs.RunTelemetry()
+    run.register_listener(obs.JsonlSink(path))
+    obs.set_process_index(3)
+    try:
+        with obs.use_run(run):
+            with obs.span("a"):
+                pass
+            run.registry.counter("c_total", "").inc()
+            run.flush_metrics()
+        run.close()  # final flush happens while the index is still set
+    finally:
+        obs.set_process_index(0)
+    lines = [json.loads(l) for l in open(path)]
+    assert lines
+    for line in lines:
+        assert line["process_index"] == 3
+        assert line["host"] == socket.gethostname()
+    (span_line,) = [l for l in lines if l["type"] == "span"]
+    assert isinstance(span_line["thread_id"], int)
 
 
 def test_jsonl_sink_serializes_device_arrays_as_placeholders(tmp_path):

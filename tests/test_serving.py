@@ -534,10 +534,10 @@ def test_serving_quantiles_in_prometheus(run_telemetry):
     assert "photon_serving_request_latency_seconds_p50" in text
     assert "photon_serving_request_latency_seconds_p95" in text
     assert "photon_serving_request_latency_seconds_p99" in text
-    # non-serving histograms keep the old exposition exactly
+    # quantile gauges render for every histogram family, serving or not
     reg.histogram("photon_other", "t").observe(1.0)
     text = obs.render_prometheus(reg.snapshot())
-    assert "photon_other_p50" not in text
+    assert "# TYPE photon_other_p50 gauge" in text
 
 
 def test_histogram_quantile_interpolation():
