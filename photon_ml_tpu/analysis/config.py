@@ -48,6 +48,18 @@ DEFAULT_TIMING_STRICT_MODULES: Tuple[str, ...] = (
     "photon_ml_tpu/optimize/*",
     "photon_ml_tpu/serving/*",
 )
+# R8 (no module-level jax import) applies here: the post-hoc report path,
+# which must import in processes with no usable jax (function-level imports
+# stay allowed — obs/run.py's record_solver_metrics is the pattern).
+DEFAULT_JAX_FREE_MODULES: Tuple[str, ...] = (
+    "photon_ml_tpu/obs/*",
+    "photon_ml_tpu/cli/report.py",
+    "photon_ml_tpu/io/__init__.py",
+    "photon_ml_tpu/io/avro.py",
+    "photon_ml_tpu/io/index_map.py",
+    "photon_ml_tpu/robust/atomic.py",
+    "photon_ml_tpu/robust/checkpoint.py",
+)
 
 
 def _match(relpath: str, patterns: Sequence[str]) -> bool:
@@ -71,6 +83,7 @@ class LintConfig:
     dtype_strict_modules: Tuple[str, ...] = DEFAULT_DTYPE_STRICT_MODULES
     atomic_write_modules: Tuple[str, ...] = DEFAULT_ATOMIC_WRITE_MODULES
     timing_strict_modules: Tuple[str, ...] = DEFAULT_TIMING_STRICT_MODULES
+    jax_free_modules: Tuple[str, ...] = DEFAULT_JAX_FREE_MODULES
     root: str = "."
 
     def is_hot(self, relpath: str) -> bool:
@@ -84,6 +97,9 @@ class LintConfig:
 
     def is_timing_strict(self, relpath: str) -> bool:
         return _match(relpath, self.timing_strict_modules)
+
+    def is_jax_free(self, relpath: str) -> bool:
+        return _match(relpath, self.jax_free_modules)
 
     def is_excluded(self, relpath: str) -> bool:
         return _match(relpath, self.exclude)

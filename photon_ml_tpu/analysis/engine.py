@@ -131,6 +131,7 @@ def analyze_source(
         dtype_strict=config.is_dtype_strict(relpath),
         atomic=config.is_atomic_write(relpath),
         timing=config.is_timing_strict(relpath),
+        jax_free=config.is_jax_free(relpath),
         rules=rules,
     )
     sup = _suppressions(source)

@@ -47,6 +47,15 @@ R6 nan-handling: (a) ``x == nan`` / ``x != nan`` against ``jnp.nan`` /
    occurrence, or reject via the divergence machinery instead of papering
    over it.
 
+R8 jax-free-import: a module-level ``import jax`` / ``from jax... import``
+   in the configured jax-free modules (the post-hoc report path: ``obs/``,
+   ``cli/report.py``, the avro/index readers). These modules are contractually
+   importable in processes with no usable jax (report rebuilds on dev
+   laptops, CI doc builds); a top-level import — even one wrapped in
+   ``try``/``except`` — breaks or degrades that contract silently. Import
+   jax inside the function that needs it, or under ``if TYPE_CHECKING:``
+   for annotations.
+
 Taint tracking is deliberately local and conservative: names become
 "jax-typed" through parameter annotations (``Array``, ``jax.Array``, ...)
 and through assignment from expressions rooted at ``jnp.`` / ``jax.`` calls
@@ -71,6 +80,7 @@ RULES: Dict[str, str] = {
     "R5": "non-atomic file write in an atomic-write module",
     "R6": "NaN mishandling (== nan compare / uncounted isnan patch)",
     "R7": "direct wall-clock timing in a timing-strict module (use obs.span/timed)",
+    "R8": "module-level jax import in a jax-free module",
 }
 
 # attributes whose value is host metadata, not an array: reading them off a
@@ -901,6 +911,64 @@ def _run_r7(mod: _Module, add: AddFn) -> None:
             )
 
 
+# --------------------------------------------------------------------------
+# R8: module-level jax import in jax-free modules
+#
+# The report path (obs/, cli/report.py, the avro/index readers) must import
+# in a process where jax is absent or poisoned — rebuilding report.html from
+# artifacts must not require an accelerator stack. Only *module-level*
+# imports break that; a function-level `import jax` inside the one code path
+# that needs it is the sanctioned pattern (and what obs/run.py does), so the
+# walk skips function bodies. `if TYPE_CHECKING:` blocks never execute at
+# runtime and are skipped too. A try/except-guarded top-level import is
+# still flagged: with jax installed it drags the whole stack into every
+# importer anyway.
+
+
+def _run_r8(mod: _Module, add: AddFn) -> None:
+    def flag(node: ast.stmt, what: str) -> None:
+        add(
+            node.lineno,
+            node.col_offset,
+            "R8",
+            f"module-level `{what}` in a jax-free module: the report path "
+            "must import without a usable jax — move the import inside the "
+            "function that needs it, or under `if TYPE_CHECKING:`",
+        )
+
+    def is_type_checking(test: ast.expr) -> bool:
+        return (isinstance(test, ast.Name) and test.id == "TYPE_CHECKING") or (
+            isinstance(test, ast.Attribute) and test.attr == "TYPE_CHECKING"
+        )
+
+    def visit(stmts: Sequence[ast.stmt]) -> None:
+        for node in stmts:
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                continue  # function-level imports are the sanctioned pattern
+            if isinstance(node, ast.Import):
+                for alias in node.names:
+                    if alias.name == "jax" or alias.name.startswith("jax."):
+                        flag(node, f"import {alias.name}")
+            elif isinstance(node, ast.ImportFrom):
+                m = node.module or ""
+                if node.level == 0 and (m == "jax" or m.startswith("jax.")):
+                    flag(node, f"from {m} import ...")
+            elif isinstance(node, ast.If):
+                if not is_type_checking(node.test):
+                    visit(node.body)
+                visit(node.orelse)
+            elif isinstance(node, ast.Try):
+                visit(node.body)
+                for h in node.handlers:
+                    visit(h.body)
+                visit(node.orelse)
+                visit(node.finalbody)
+            elif isinstance(node, (ast.With, ast.ClassDef)):
+                visit(node.body)
+
+    visit(mod.tree.body)
+
+
 def run_rules(
     tree: ast.Module,
     *,
@@ -908,12 +976,14 @@ def run_rules(
     dtype_strict: bool,
     atomic: bool = False,
     timing: bool = False,
+    jax_free: bool = False,
     rules: Optional[Sequence[str]] = None,
 ) -> List[RawFinding]:
     """All rule passes over one parsed module. ``hot`` enables R1;
     ``dtype_strict`` enables R3's jnp.array-without-dtype subrule;
     ``atomic`` enables R5 (direct-write detection in persistence modules);
-    ``timing`` enables R7 (wall-clock timing outside obs.span/timed)."""
+    ``timing`` enables R7 (wall-clock timing outside obs.span/timed);
+    ``jax_free`` enables R8 (no module-level jax import)."""
     mod = _Module(tree)
     out: List[RawFinding] = []
     enabled = set(rules) if rules is not None else set(RULES)
@@ -939,5 +1009,7 @@ def run_rules(
         _run_r6(mod, hot, adder("R6"))
     if timing and "R7" in enabled:
         _run_r7(mod, adder("R7"))
+    if jax_free and "R8" in enabled:
+        _run_r8(mod, adder("R8"))
     out.sort(key=lambda f: (f.line, f.col, f.rule))
     return out

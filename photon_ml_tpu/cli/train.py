@@ -234,6 +234,16 @@ def build_parser() -> argparse.ArgumentParser:
         "(JSON: current sweep/coordinate, accepted losses, rejection "
         "counters) on this port while training (0 = ephemeral port)",
     )
+    p.add_argument(
+        "--report-out",
+        default=None,
+        help="directory for the post-hoc training report (coordinator only): "
+        "report.json (machine-readable model/convergence/performance "
+        "diagnostics) and report.html (self-contained, stdlib-rendered). "
+        "Implies --metrics-out into the same directory when that flag is "
+        "absent, so the report directory is a complete artifact set that "
+        "`cli report` can rebuild from",
+    )
     return p
 
 
@@ -278,6 +288,11 @@ def run(argv: Optional[List[str]] = None) -> Dict:
     metric_sinks = []
     recorder = None
     status_server = None
+    if args.report_out and not args.metrics_out:
+        # the report is rebuilt from on-disk artifacts; without a metrics dir
+        # the trajectories would have nothing to read, so the report dir
+        # doubles as the metrics dir
+        args.metrics_out = args.report_out
     telemetry_on = bool(
         args.metrics_out or args.trace_out or args.status_port is not None
     )
@@ -309,7 +324,19 @@ def run(argv: Optional[List[str]] = None) -> Dict:
         if args.metrics_out:
             logger.info("run telemetry -> %s", args.metrics_out)
     try:
-        return _run_training(args, run_t, metric_sinks, t_run0, recorder)
+        summary = _run_training(args, run_t, metric_sinks, t_run0, recorder)
+    except BaseException:
+        # crash-flush: a mid-sweep abort (including an injected
+        # SimulatedKill) still leaves run_summary.json on disk with the
+        # partial timeline / phase attribution collected so far, marked
+        # "aborted" — the report and post-mortems read it
+        if run_t is not None:
+            try:
+                _write_run_summary(args, run_t, recorder, t_run0, aborted=True)
+            except Exception:
+                obs.swallowed_error("cli.run_summary_flush")
+                logger.exception("could not flush partial run summary")
+        raise
     finally:
         if status_server is not None:
             status_server.stop()
@@ -317,6 +344,9 @@ def run(argv: Optional[List[str]] = None) -> Dict:
             # final flush: last metrics.jsonl line + the final metrics.prom
             run_t.close()
             obs.set_current_run(prev_run)
+    if args.report_out and multihost.is_coordinator():
+        _emit_report(args)
+    return summary
 
 
 def _run_training(args, run_t, metric_sinks, t_run0, recorder=None) -> Dict:
@@ -566,28 +596,7 @@ def _run_training(args, run_t, metric_sinks, t_run0, recorder=None) -> Dict:
         },
     }
     if run_t is not None:
-        doc = obs.build_run_summary(
-            run_t.registry, total_wall_seconds=time.perf_counter() - t_run0
-        )
-        doc["task"] = summary["task"]
-        doc["best"] = summary["best"]
-        if recorder is not None:
-            # drain the listener queue: the "train" span above has closed by
-            # here, so the timeline holds the whole run
-            doc["timeline"] = recorder.phase_attribution()
-            recorder.write_chrome_trace(args.trace_out)
-            logger.info("chrome trace -> %s (load at ui.perfetto.dev)",
-                        args.trace_out)
-        # --trace-out without --metrics-out still gets a run_summary.json
-        # (the phase attribution belongs with the trace): next to the trace
-        summary_dir = args.metrics_out or os.path.dirname(
-            os.path.abspath(args.trace_out or "")
-        )
-        if args.metrics_out or args.trace_out:
-            atomic_write_json(
-                os.path.join(summary_dir, "run_summary.json"),
-                doc, indent=2, default=float,
-            )
+        _write_run_summary(args, run_t, recorder, t_run0, summary=summary)
     if not multihost.is_coordinator():
         # only process 0 writes outputs (the reference's driver-to-HDFS role)
         return summary
@@ -610,6 +619,81 @@ def _run_training(args, run_t, metric_sinks, t_run0, recorder=None) -> Dict:
         )
     logger.info("saved %d model(s) to %s", len(to_save), args.output_dir)
     return summary
+
+
+def _write_run_summary(args, run_t, recorder, t_run0, summary=None,
+                       aborted=False) -> None:
+    """Write run_summary.json (+ the Chrome trace) from the run's registry.
+
+    Shared between the end-of-run path and the crash-flush in ``run()``: on
+    a mid-sweep abort ``summary`` is None, the document carries
+    ``"aborted": true``, and the timeline holds every span that closed
+    before the abort."""
+    try:
+        import jax
+
+        devices = jax.local_devices()
+    except Exception:  # photon: ignore[R4] - no-jax fallback, host-only sample
+        devices = ()
+    # final sample so host/device watermarks are present even for runs that
+    # never reached a sweep boundary
+    obs.sample_memory(run_t.registry, devices=devices)
+    doc = obs.build_run_summary(
+        run_t.registry, total_wall_seconds=time.perf_counter() - t_run0
+    )
+    doc["task"] = getattr(args, "task", None) if summary is None else summary["task"]
+    if summary is not None:
+        doc["best"] = summary["best"]
+    if aborted:
+        doc["aborted"] = True
+    if recorder is not None:
+        # drain the listener queue: on the normal path the "train" span has
+        # closed by here, so the timeline holds the whole run
+        doc["timeline"] = recorder.phase_attribution()
+        recorder.write_chrome_trace(args.trace_out)
+        logger.info("chrome trace -> %s (load at ui.perfetto.dev)",
+                    args.trace_out)
+    # --trace-out without --metrics-out still gets a run_summary.json
+    # (the phase attribution belongs with the trace): next to the trace
+    summary_dir = args.metrics_out or os.path.dirname(
+        os.path.abspath(args.trace_out or "")
+    )
+    if args.metrics_out or args.trace_out:
+        atomic_write_json(
+            os.path.join(summary_dir, "run_summary.json"),
+            doc, indent=2, default=float,
+        )
+
+
+def _emit_report(args) -> None:
+    """Build report.json + report.html under --report-out.
+
+    Reads back the artifacts just written to disk (run_summary.json,
+    metrics.jsonl, training-summary.json, saved models) rather than any
+    in-memory state, so a later ``cli report`` over the same directory
+    reproduces report.json byte-identically."""
+    from ..obs import report as report_mod
+
+    try:
+        inputs = report_mod.collect_training_inputs(
+            summary_dir=args.metrics_out or (
+                os.path.dirname(os.path.abspath(args.trace_out))
+                if args.trace_out else None
+            ),
+            output_dir=args.output_dir,
+            checkpoint_dir=args.checkpoint_dir,
+            feature_index_dir=args.feature_index_dir,
+        )
+        paths = report_mod.write_report(
+            report_mod.build_report(inputs), args.report_out
+        )
+    except Exception:
+        # the report is a post-hoc convenience; a rendering bug must not
+        # turn a finished (and saved) training run into a CLI failure
+        obs.swallowed_error("cli.report_out")
+        logger.exception("training report generation failed")
+        return
+    logger.info("training report -> %s", paths["html"])
 
 
 # shared with io/data's chunked training-data reader (utils/futures.py);

@@ -33,6 +33,17 @@ from .coordinate import Coordinate, ModelCoordinate
 logger = logging.getLogger("photon_ml_tpu")
 
 
+def _local_devices():
+    """Device handles for memory sampling; empty when the backend is not up
+    (sampling then covers host RSS only)."""
+    try:
+        import jax
+
+        return jax.local_devices()
+    except Exception:  # photon: ignore[R4] - no-jax fallback, host-only sample
+        return ()
+
+
 @dataclasses.dataclass
 class CoordinateDescentResult:
     model: GameModel
@@ -305,6 +316,16 @@ class CoordinateDescent:
                                 scores[name] = new_scores
                                 if train_loss is not None:
                                     train_losses[name] = train_loss
+                                    # cheap host registry write (the loss
+                                    # already traveled in the guard's fetch):
+                                    # per-sweep JSONL flushes turn this gauge
+                                    # into the accepted-loss trajectory the
+                                    # post-hoc report plots
+                                    obs.current_run().registry.gauge(
+                                        "photon_cd_accepted_loss",
+                                        "last accepted total train loss per "
+                                        "coordinate",
+                                    ).labels(coordinate=name).set(train_loss)
                                     obs.current_run().status.update(
                                         accepted_losses={
                                             k: float(v)
@@ -363,6 +384,13 @@ class CoordinateDescent:
                 if self.checkpoint_fn is not None:
                     with obs.span("cd.checkpoint", phase="checkpoint"):
                         self.checkpoint_fn(it, dict(models))
+            # memory watermarks at the sweep boundary (host RSS via /proc,
+            # device HBM via memory_stats when the backend has it): cheap
+            # host-only reads, recorded with or without a sink so the peaks
+            # land in run_summary.json for every run
+            obs.sample_memory(
+                obs.current_run().registry, devices=_local_devices()
+            )
             if obs.active():
                 # one metrics line per sweep in the JSONL stream
                 obs.current_run().flush_metrics()

@@ -1,36 +1,48 @@
-from .avro import iter_avro_directory, parse_schema, read_avro_file, write_avro_file
-from .columns import InputColumnsNames
-from .data import (
-    FeatureShardConfig,
-    RawDataset,
-    build_index_maps,
-    read_avro_dataset,
-    read_avro_dataset_chunked,
-    read_libsvm,
-    records_to_dataset,
-)
-from .index_map import INTERCEPT_KEY, IndexMap, feature_key, split_feature_key
-from .model_io import load_game_model, load_glm, save_game_model, save_glm
+"""IO package with lazy submodule exports.
 
-__all__ = [
-    "read_avro_file",
-    "write_avro_file",
-    "iter_avro_directory",
-    "parse_schema",
-    "FeatureShardConfig",
-    "InputColumnsNames",
-    "RawDataset",
-    "read_avro_dataset",
-    "read_avro_dataset_chunked",
-    "read_libsvm",
-    "records_to_dataset",
-    "build_index_maps",
-    "IndexMap",
-    "INTERCEPT_KEY",
-    "feature_key",
-    "split_feature_key",
-    "save_glm",
-    "load_glm",
-    "save_game_model",
-    "load_game_model",
-]
+``io.avro`` and ``io.index_map`` are jax-free by design (lint rule R8) so
+the post-hoc report path (`cli report`) can read saved models and feature
+indexes on a dev box with no accelerator stack. ``io.data`` / ``io.model_io``
+import jax; resolving every name lazily (PEP 562) keeps `import
+photon_ml_tpu.io` itself jax-free.
+"""
+
+_EXPORTS = {
+    "read_avro_file": "avro",
+    "write_avro_file": "avro",
+    "iter_avro_directory": "avro",
+    "parse_schema": "avro",
+    "InputColumnsNames": "columns",
+    "FeatureShardConfig": "data",
+    "RawDataset": "data",
+    "read_avro_dataset": "data",
+    "read_avro_dataset_chunked": "data",
+    "read_libsvm": "data",
+    "records_to_dataset": "data",
+    "build_index_maps": "data",
+    "IndexMap": "index_map",
+    "INTERCEPT_KEY": "index_map",
+    "feature_key": "index_map",
+    "split_feature_key": "index_map",
+    "save_glm": "model_io",
+    "load_glm": "model_io",
+    "save_game_model": "model_io",
+    "load_game_model": "model_io",
+}
+
+__all__ = list(_EXPORTS)
+
+
+def __getattr__(name):
+    submodule = _EXPORTS.get(name)
+    if submodule is None:
+        raise AttributeError(f"module {__name__!r} has no attribute {name!r}")
+    import importlib
+
+    value = getattr(importlib.import_module(f".{submodule}", __name__), name)
+    globals()[name] = value
+    return value
+
+
+def __dir__():
+    return sorted(set(globals()) | set(__all__))

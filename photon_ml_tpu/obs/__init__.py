@@ -20,6 +20,7 @@ wires this up end to end.
 """
 
 from .http import IntrospectionServer, compose_statusz
+from .memory import memory_block, read_host_memory, sample_memory
 from .metrics import (
     DEFAULT_BUCKETS,
     MetricsRegistry,
@@ -76,7 +77,10 @@ __all__ = [
     "current_span",
     "get_process_index",
     "histogram_quantile",
+    "memory_block",
+    "read_host_memory",
     "record_solver_metrics",
+    "sample_memory",
     "render_prometheus",
     "set_current_run",
     "set_process_index",

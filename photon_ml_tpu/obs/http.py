@@ -6,11 +6,16 @@
 it is happening* instead of reading metric files after the fact:
 
 - ``/metrics``  — Prometheus text exposition rendered from the live registry
-- ``/healthz``  — liveness probe, ``{"status": "ok"}``
+- ``/healthz``  — liveness probe, ``{"status": "ok"}``; returns 503 while
+  the StatusBoard's ``refresh_in_progress`` flag is set (the serving side
+  raises it around a snapshot-refresh engine flip so load balancers drain
+  traffic for exactly the flip window)
 - ``/statusz``  — JSON runtime status: current sweep / coordinate and
   accepted losses (from the run's StatusBoard), rejection / divergence
-  counters and stream-slice progress (derived from the registry), and —
-  when serving metrics exist — request QPS and latency quantiles.
+  counters and stream-slice progress (derived from the registry), a
+  ``memory`` section (live host RSS + recorded HBM watermarks and
+  hbm.budget headroom when streaming), and — when serving metrics exist —
+  request QPS and latency quantiles.
 
 All handlers read snapshots under the registry/board locks, never the live
 structures, so a scrape can never block or torn-read the training thread.
@@ -24,6 +29,7 @@ import threading
 import time
 from typing import Dict, Optional
 
+from .memory import memory_block, read_host_memory
 from .metrics import histogram_quantile
 from .run import RunTelemetry, current_run
 
@@ -61,6 +67,15 @@ def compose_statusz(run: RunTelemetry, qps: Optional[float] = None) -> dict:
     swallowed = _sum_counter(snap, "photon_swallowed_errors_total")
     if swallowed:
         doc["swallowed_errors"] = int(swallowed)
+
+    # live host reading + recorded device/stream watermarks: a scrape shows
+    # where memory stands NOW even between sweep-boundary samples
+    memory = memory_block(snap)
+    host_now = read_host_memory()
+    if host_now:
+        memory.setdefault("host", {}).update(host_now)
+    if memory:
+        doc["memory"] = memory
 
     stream: dict = {}
     slices = _sum_counter(snap, "photon_stream_slices_total")
@@ -116,6 +131,19 @@ class IntrospectionServer:
                     body = server._render_metrics().encode("utf-8")
                     ctype = "text/plain; version=0.0.4; charset=utf-8"
                 elif path == "/healthz":
+                    # 503 while a serving snapshot-refresh flip is
+                    # mid-publish: the board flag brackets exactly the
+                    # build+warm+swap window (serving/server.py _install)
+                    if server.run().status.snapshot().get("refresh_in_progress"):
+                        body = json.dumps({"status": "refreshing"}).encode(
+                            "utf-8"
+                        )
+                        self.send_response(503)
+                        self.send_header("Content-Type", "application/json")
+                        self.send_header("Content-Length", str(len(body)))
+                        self.end_headers()
+                        self.wfile.write(body)
+                        return
                     body = json.dumps({"status": "ok"}).encode("utf-8")
                     ctype = "application/json"
                 elif path == "/statusz":

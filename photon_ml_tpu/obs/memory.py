@@ -1,0 +1,152 @@
+"""Memory accounting: ``photon_mem_*`` gauges for host RSS and device HBM.
+
+The numbers the `hbm.budget.mb` streaming planner and the multichip bench
+need to be defensible: how much host memory the run actually held, and how
+close each device came to its HBM limit. Sampling happens at CD sweep
+boundaries (game/descent.py) and once more before run_summary.json is
+written, so the high-water marks cover the whole run.
+
+This module is jax-free by design (lint rule R8): device handles are passed
+IN by callers that already hold jax. ``device.memory_stats()`` is a host-side
+C call where supported (TPU/GPU); backends without it (CPU) are skipped.
+
+Gauge families::
+
+    photon_mem_host_rss_bytes            VmRSS at the last sample
+    photon_mem_host_peak_rss_bytes       VmHWM (kernel-tracked high water)
+    photon_mem_device_bytes_in_use{device=}       allocator bytes in use
+    photon_mem_device_peak_bytes_in_use{device=}  max over samples (or the
+                                                  allocator's own peak stat)
+    photon_mem_device_bytes_limit{device=}        allocator capacity
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Dict, Iterable, List, Optional
+
+_PROC_STATUS = "/proc/self/status"
+
+
+def read_host_memory(proc_status: str = _PROC_STATUS) -> Dict[str, int]:
+    """Host memory from ``/proc/self/status``: ``rss_bytes`` (VmRSS) and
+    ``peak_rss_bytes`` (VmHWM — the kernel's own high-water mark, so a spike
+    between samples is still captured). Falls back to ``resource.getrusage``
+    (peak only) off Linux; returns {} when neither source exists."""
+    out: Dict[str, int] = {}
+    try:
+        with open(proc_status) as f:
+            for line in f:
+                if line.startswith("VmRSS:"):
+                    out["rss_bytes"] = int(line.split()[1]) * 1024
+                elif line.startswith("VmHWM:"):
+                    out["peak_rss_bytes"] = int(line.split()[1]) * 1024
+    except OSError:
+        pass
+    if "peak_rss_bytes" not in out:
+        try:
+            import resource
+
+            # ru_maxrss is KiB on Linux, bytes on macOS; this branch only
+            # runs off Linux where /proc is absent — assume KiB is wrong less
+            # often than guessing the platform, and keep the Linux unit
+            out["peak_rss_bytes"] = (
+                int(resource.getrusage(resource.RUSAGE_SELF).ru_maxrss) * 1024
+            )
+        except Exception:  # photon: ignore[R4] - no resource module: no peak
+            pass
+    return out
+
+
+def _set_peak(family, value: float, **labels) -> None:
+    """Monotone gauge: keep the max of the current and the new value."""
+    child = family.labels(**labels)
+    if value > child.value:
+        child.set(value)
+
+
+def sample_memory(registry, devices: Optional[Iterable] = None) -> Dict[str, int]:
+    """Record one memory sample into ``registry``'s ``photon_mem_*`` gauges.
+
+    Cheap host-only work (a /proc read + optional allocator-stat calls), so
+    instrumentation sites call it unconditionally — like StatusBoard updates
+    it works on passive runs too. Returns the host reading."""
+    host = read_host_memory()
+    if "rss_bytes" in host:
+        registry.gauge(
+            "photon_mem_host_rss_bytes", "host resident set size at last sample"
+        ).set(host["rss_bytes"])
+    if "peak_rss_bytes" in host:
+        _set_peak(
+            registry.gauge(
+                "photon_mem_host_peak_rss_bytes",
+                "host resident set size high-water mark (VmHWM)",
+            ),
+            host["peak_rss_bytes"],
+        )
+    for dev in devices or ():
+        try:
+            stats = dev.memory_stats()
+        except Exception:  # photon: ignore[R4] - backend without memory_stats
+            stats = None
+        if not stats:
+            continue
+        label = str(getattr(dev, "id", dev))
+        in_use = stats.get("bytes_in_use")
+        if in_use is not None:
+            registry.gauge(
+                "photon_mem_device_bytes_in_use",
+                "device allocator bytes in use at last sample",
+            ).labels(device=label).set(float(in_use))
+        peak = stats.get("peak_bytes_in_use", in_use)
+        if peak is not None:
+            _set_peak(
+                registry.gauge(
+                    "photon_mem_device_peak_bytes_in_use",
+                    "device allocator bytes-in-use high-water mark",
+                ),
+                float(peak),
+                device=label,
+            )
+        limit = stats.get("bytes_limit")
+        if limit is not None:
+            registry.gauge(
+                "photon_mem_device_bytes_limit", "device allocator capacity"
+            ).labels(device=label).set(float(limit))
+    return host
+
+
+def memory_block(snapshot: List[dict]) -> dict:
+    """The ``memory`` document for run_summary.json / /statusz / the report,
+    assembled from a registry snapshot's ``photon_mem_*`` (and, when the run
+    streamed, ``photon_stream_budget*``) gauges. Empty dict when the run
+    never sampled."""
+    host: Dict[str, float] = {}
+    devices: Dict[str, dict] = {}
+    budget: Dict[str, dict] = {}
+    for m in snapshot:
+        name, value = m["name"], m.get("value")
+        if value is None:
+            continue
+        if name == "photon_mem_host_rss_bytes":
+            host["rss_bytes"] = int(value)
+        elif name == "photon_mem_host_peak_rss_bytes":
+            host["peak_rss_bytes"] = int(value)
+        elif name.startswith("photon_mem_device_"):
+            dev = str(m.get("labels", {}).get("device", ""))
+            key = name[len("photon_mem_device_"):]
+            devices.setdefault(dev, {})[key] = int(value)
+        elif name == "photon_stream_budget_bytes":
+            site = str(m.get("labels", {}).get("site", ""))
+            budget.setdefault(site, {})["hbm_budget_bytes"] = int(value)
+        elif name == "photon_stream_budget_headroom_bytes":
+            site = str(m.get("labels", {}).get("site", ""))
+            budget.setdefault(site, {})["hbm_budget_headroom_bytes"] = int(value)
+    out: dict = {}
+    if host:
+        out["host"] = host
+    if devices:
+        out["devices"] = devices
+    if budget:
+        out["streaming"] = budget
+    return out

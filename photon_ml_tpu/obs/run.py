@@ -188,8 +188,11 @@ def record_solver_metrics(solver: str, result) -> None:
 
 def build_run_summary(registry: MetricsRegistry, total_wall_seconds: float) -> dict:
     """The ``run_summary.json`` document: total wall time, per-coordinate
-    iteration StatCounters and convergence-reason histograms, and the full
-    final metrics snapshot."""
+    iteration StatCounters and convergence-reason histograms, memory
+    watermarks (when the run sampled any), and the full final metrics
+    snapshot."""
+    from .memory import memory_block
+
     snap = registry.snapshot()
     coordinates: dict = {}
     for m in snap:
@@ -204,8 +207,12 @@ def build_run_summary(registry: MetricsRegistry, total_wall_seconds: float) -> d
             ] = int(m["value"])
         elif m["name"] == "photon_coordinate_rejections_total":
             coordinates.setdefault(coord, {})["rejections"] = int(m["value"])
-    return {
+    doc = {
         "total_wall_seconds": float(total_wall_seconds),
         "coordinates": coordinates,
         "metrics": snap,
     }
+    mem = memory_block(snap)
+    if mem:
+        doc["memory"] = mem
+    return doc

@@ -165,9 +165,18 @@ def record_tracker_metrics(registry, coordinate_name: str, tracker) -> None:
         "photon_cd_convergence_reason_total",
         "coordinate-update solves by termination reason",
     )
+    # latest-update iterations as a gauge: the cumulative summary above
+    # cannot be read back per sweep, but this gauge lands in every per-sweep
+    # metrics.jsonl flush — the report's solver-iterations trajectory
+    latest = registry.gauge(
+        "photon_cd_update_iterations",
+        "solver iterations of the latest coordinate update (entity mean "
+        "for random effects)",
+    ).labels(coordinate=coordinate_name)
     if isinstance(tracker, RandomEffectOptimizationTracker):
         st = tracker.iterations_stats
         iters.merge_stat(st.count, st.mean, st.stdev, st.max, st.min)
+        latest.set(st.mean)
         for reason, n in tracker.convergence_reasons.items():
             reasons.labels(coordinate=coordinate_name, reason=reason).inc(n)
     else:
@@ -176,6 +185,7 @@ def record_tracker_metrics(registry, coordinate_name: str, tracker) -> None:
             "tracker_metrics", (r.iterations, r.reason, r.loss)
         )
         iters.observe(int(iters_v))
+        latest.set(int(iters_v))
         reasons.labels(
             coordinate=coordinate_name,
             reason=ConvergenceReason(int(reason_v)).name,

@@ -95,12 +95,23 @@ class ScoringServer:
         """Build the engine for a freshly opened store, then flip the live
         reference in one assignment (warm first: the flip must not stall
         in-flight traffic on a compile)."""
-        engine = ScoreEngine.from_store(store, dtype=self.dtype)
-        if getattr(self, "_batcher", None) is not None:
-            engine.warm()
-        with self._lock:
-            self._engine = engine
-            self.snapshot_name = name
+        live = getattr(self, "_batcher", None) is not None
+        if live:
+            # /healthz answers 503 for exactly the mid-publish window, so a
+            # load balancer drains this replica while the flip is in flight
+            # (scoring itself keeps working — the old engine serves until
+            # the one-assignment swap below)
+            obs.current_run().status.update(refresh_in_progress=True)
+        try:
+            engine = ScoreEngine.from_store(store, dtype=self.dtype)
+            if live:
+                engine.warm()
+            with self._lock:
+                self._engine = engine
+                self.snapshot_name = name
+        finally:
+            if live:
+                obs.current_run().status.update(refresh_in_progress=False)
         if getattr(self, "_status_server", None) is not None:
             obs.current_run().status.update(serving_snapshot=name)
 

@@ -520,6 +520,82 @@ def test_r7_suppressed_inline():
     assert [f.rule for f in fs if f.suppressed] == ["R7"]
 
 
+# ---------------------------------------------------------------- R8
+
+JAXFREE = "photon_ml_tpu/obs/report.py"  # matches default jax_free_modules
+
+R8_SRC = """
+    import jax
+    import jax.numpy as jnp
+    from jax import tree_util
+    """
+
+
+def test_r8_fires_in_jax_free_module():
+    fs = findings(R8_SRC, JAXFREE)
+    assert rules_of(fs) == ["R8", "R8", "R8"]
+    assert "jax-free" in fs[0].message
+
+
+def test_r8_silent_outside_jax_free_modules():
+    assert rules_of(findings(R8_SRC, COLD)) == []
+
+
+def test_r8_allows_function_level_and_type_checking_imports():
+    src = """
+    from typing import TYPE_CHECKING
+
+    if TYPE_CHECKING:
+        import jax
+
+    def fetch(x):
+        import jax.numpy as jnp
+
+        return jnp.asarray(x)
+    """
+    assert rules_of(findings(src, JAXFREE)) == []
+
+
+def test_r8_catches_try_guarded_and_nested_module_imports():
+    src = """
+    try:
+        import jax
+    except ImportError:
+        jax = None
+
+    if True:
+        from jax.experimental import mesh_utils
+    """
+    assert rules_of(findings(src, JAXFREE)) == ["R8", "R8"]
+
+
+def test_r8_suppressed_inline():
+    src = """
+    import jax  # photon: ignore[R8]
+    """
+    fs = findings(src, JAXFREE)
+    assert rules_of(fs) == []
+    assert [f.rule for f in fs if f.suppressed] == ["R8"]
+
+
+def test_r8_report_path_modules_lint_clean():
+    """The shipped jax-free modules must satisfy their own rule."""
+    root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    config = load_config(os.path.join(root, "pyproject.toml"))
+    for rel in (
+        "photon_ml_tpu/obs/report.py",
+        "photon_ml_tpu/obs/diagnostics.py",
+        "photon_ml_tpu/obs/memory.py",
+        "photon_ml_tpu/cli/report.py",
+        "photon_ml_tpu/io/__init__.py",
+    ):
+        assert config.is_jax_free(rel), rel
+        with open(os.path.join(root, rel), encoding="utf-8") as f:
+            src = f.read()
+        fs = analyze_source(src, rel, config=config, rules=["R8"])
+        assert [f.rule for f in fs if f.active] == [], rel
+
+
 # ----------------------------------------------------- suppression mechanics
 
 

@@ -374,12 +374,23 @@ def test_checkpoint_resume_matches_straight_run(avro_paths, tmp_path, monkeypatc
         train.run(common + [
             "--checkpoint-dir", ckpt,
             "--output-dir", str(tmp_path / "out1"),
+            "--metrics-out", str(tmp_path / "m1"),
+            "--trace-out", str(tmp_path / "m1" / "trace.json"),
         ])
     monkeypatch.undo()
     with open(os.path.join(ckpt, "checkpoint-state.json")) as f:
         state = json.load(f)
     assert state["current"]["completed_sweeps"] == 2
     assert state["completed"] == []
+    # the mid-sweep abort still flushed run_summary.json: aborted marker,
+    # the partial timeline (both completed sweeps closed their spans), and
+    # the memory watermarks sampled in the crash path
+    with open(os.path.join(str(tmp_path / "m1"), "run_summary.json")) as f:
+        aborted_doc = json.load(f)
+    assert aborted_doc["aborted"] is True
+    assert aborted_doc["timeline"]["n_sweeps"] >= 2
+    assert aborted_doc["memory"]["host"]["rss_bytes"] > 0
+    assert os.path.exists(str(tmp_path / "m1" / "trace.json"))
 
     # resume: same command trains only the remaining 2 sweeps
     train.run(common + [

@@ -294,6 +294,53 @@ def test_statusz_serving_section_and_qps():
         srv.stop()
 
 
+def test_healthz_503_while_refresh_in_progress():
+    """The serving snapshot-refresh flip raises refresh_in_progress on the
+    StatusBoard; /healthz answers 503 for exactly that window so a load
+    balancer drains the replica mid-publish."""
+    run = obs.RunTelemetry()
+    srv = obs.IntrospectionServer(run, port=0)
+    try:
+        base = f"http://127.0.0.1:{srv.port}"
+        run.status.update(refresh_in_progress=True)
+        with pytest.raises(urllib.error.HTTPError) as e:
+            _get(base + "/healthz")
+        assert e.value.code == 503
+        assert json.loads(e.value.read()) == {"status": "refreshing"}
+        run.status.update(refresh_in_progress=False)
+        status, _, body = _get(base + "/healthz")
+        assert status == 200 and json.loads(body) == {"status": "ok"}
+    finally:
+        srv.stop()
+
+
+def test_statusz_memory_section():
+    """/statusz carries live host RSS plus recorded device watermarks and
+    hbm.budget headroom when the run sampled/streamed any."""
+    run = obs.RunTelemetry()
+    reg = run.registry
+    obs.sample_memory(reg)
+    reg.gauge("photon_mem_device_peak_bytes_in_use", "").labels(
+        device="0"
+    ).set(4096)
+    reg.gauge("photon_stream_budget_bytes", "").labels(site="fe.train").set(
+        2048
+    )
+    reg.gauge("photon_stream_budget_headroom_bytes", "").labels(
+        site="fe.train"
+    ).set(1024)
+    srv = obs.IntrospectionServer(run, port=0)
+    try:
+        doc = json.loads(_get(f"http://127.0.0.1:{srv.port}/statusz")[2])
+        mem = doc["memory"]
+        assert mem["host"]["rss_bytes"] > 0  # live reading, not the sample
+        assert mem["devices"]["0"]["peak_bytes_in_use"] == 4096
+        assert mem["streaming"]["fe.train"]["hbm_budget_bytes"] == 2048
+        assert mem["streaming"]["fe.train"]["hbm_budget_headroom_bytes"] == 1024
+    finally:
+        srv.stop()
+
+
 def test_concurrent_scrape_during_span_storm():
     """Scrapes while another thread hammers spans + status updates: every
     response is complete, parseable, and never deadlocks the emitting
