@@ -34,6 +34,8 @@ load the host happens to have. Refresh explicitly with
 
   python bench.py --config streamed-fe  # out-of-core FE rows under
                                         # hbm.budget.mb + obs overlap evidence
+  python bench.py --config multichip    # examples/sec/chip vs virtual mesh
+                                        # size (dryrun_multichip shapes)
 
 Real training runs report through the telemetry files instead of stdout
 scraping: train with ``cli.train --metrics-out DIR``, then
@@ -352,19 +354,32 @@ def _iteration_counts(result):
     return out
 
 
-def bench_streamed_fe(n=200_000, d=1024, budget_mb=64, reg=1.0, max_iter=15):
+def bench_streamed_fe(
+    n=200_000, d=1024, budget_mb=64, reg=1.0, max_iter=15, pipeline_depth=2
+):
     """Out-of-core fixed effect under hbm.budget.mb vs the HBM-resident path
     on the SAME problem: the streamed objective stages double-buffered row
     slices through the chip, so its overhead over resident is the stage time
     that fails to hide under the solve. Evidence comes from the obs counters
     the streamed path emits (photon_stream_* at site=fe.train): staged bytes,
     stage seconds, solve seconds — overlap = stage/solve (<1 means the H2D
-    copies fit under the compute shadow).
+    copies fit under the compute shadow), plus the span-measured
+    ``photon_stream_overlap_ratio`` (stage wall actually concurrent with the
+    compute shadow — dispatch-loop pass windows with slice kernels in flight
+    plus the blocking collect fetch; 0.0 under the serial double buffer
+    because inline staging runs ON the solve thread, serial with the very
+    compute it sits between).
+
+    ``pipeline_depth >= 2`` stages slices through the background prefetch
+    lane (game/pipeline.py), so stage wall genuinely overlaps the collect
+    shadow instead of serializing with it — same slice geometry, bit-identical
+    coefficients.
 
     value = streamed examples/sec per value+grad pass (n * vg_passes / solve
     wall); vs_baseline = resident wall / streamed wall (1.0 = streaming is
     free, below 1.0 = the price paid for not holding the batch in HBM)."""
     from photon_ml_tpu import obs
+    from photon_ml_tpu.game import pipeline as sweep_pipeline
     from photon_ml_tpu.game.coordinate import FixedEffectCoordinate
     from photon_ml_tpu.game.data import FixedEffectDataset, HostRowBatch
     from photon_ml_tpu.game.problem import GLMOptimizationConfig
@@ -425,11 +440,13 @@ def bench_streamed_fe(n=200_000, d=1024, budget_mb=64, reg=1.0, max_iter=15):
     jax.block_until_ready(m_res.model.coefficients.means)
     wall_resident = time.perf_counter() - t0
 
-    streamed().train(None)
+    with sweep_pipeline.pipelined(pipeline_depth):
+        streamed().train(None)
     run = obs.RunTelemetry()
     with obs.use_run(run):
         t0 = time.perf_counter()
-        m_str, _ = streamed().train(None)
+        with sweep_pipeline.pipelined(pipeline_depth):
+            m_str, _ = streamed().train(None)
         jax.block_until_ready(m_str.model.coefficients.means)
         wall_streamed = time.perf_counter() - t0
 
@@ -455,20 +472,197 @@ def bench_streamed_fe(n=200_000, d=1024, budget_mb=64, reg=1.0, max_iter=15):
     vg = int(stream.get("photon_stream_passes_total{kind=vg}", 0))
     slices = int(stream.get("photon_stream_slices_total", 0))
     overlap = stage_s / max(solve_s, 1e-9)
+    overlap_ratio = stream.get("photon_stream_overlap_ratio", 0.0)
     ex_per_sec = n * max(vg, 1) / max(solve_s, 1e-9)
     return {
         "metric": "streamed_fe_examples_per_sec_per_chip",
         "value": round(ex_per_sec, 1),
         "unit": (
             f"examples/sec/chip across value+grad passes (n={n}, d={d}, "
-            f"hbm.budget.mb={budget_mb}: {slices} row slices staged, "
+            f"hbm.budget.mb={budget_mb}, pipeline.depth={pipeline_depth}: "
+            f"{slices} row slices staged, "
             f"{staged_gb:.2f} GB host->device over {vg} v+g passes; stage "
             f"{stage_s:.2f}s inside solve {solve_s:.2f}s = {overlap:.2f} "
-            "stage/solve overlap ratio; walls resident "
-            f"{wall_resident:.2f}s vs streamed {wall_streamed:.2f}s; "
+            "stage/solve ratio; span-measured stage/solve overlap "
+            f"{overlap_ratio:.3f} (serial double buffer = 0.000); walls "
+            f"resident {wall_resident:.2f}s vs streamed {wall_streamed:.2f}s; "
             f"coefficient parity max|drift|={drift:.1e})"
         ),
         "vs_baseline": round(wall_resident / wall_streamed, 2),
+        "quadrants": {
+            "stream": {
+                "overlap_ratio": round(float(overlap_ratio), 4),
+                "stage_sec": round(float(stage_s), 4),
+                "solve_sec": round(float(solve_s), 4),
+            }
+        },
+    }
+
+
+def _bench_multichip_child(n_devices: int) -> dict:
+    """One mesh size of the multichip bench, meant to run in a fresh process
+    (the CPU backend's virtual device count is fixed at first backend init).
+    Same shapes as ``__graft_entry__.dryrun_multichip``: a (data x model)
+    mesh over a tiled TRON fixed effect plus two LBFGS random effects, weak
+    scaling (rows and entities grow with the mesh)."""
+    # the child runs before any jax import in its process, so the portable
+    # pre-init knob works on every jax this repo supports (the
+    # jax_num_cpu_devices config option only exists on newer jax)
+    flags = os.environ.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in flags:
+        os.environ["XLA_FLAGS"] = (
+            flags + f" --xla_force_host_platform_device_count={n_devices}"
+        )
+
+    import jax
+
+    if len(jax.devices()) < n_devices:
+        import jax.extend.backend
+
+        jax.config.update("jax_platforms", "cpu")
+        jax.extend.backend.clear_backends()
+        jax.config.update("jax_num_cpu_devices", n_devices)
+    assert len(jax.devices()) >= n_devices
+
+    from photon_ml_tpu.estimators.game_estimator import (
+        CoordinateConfig,
+        GameEstimator,
+    )
+    from photon_ml_tpu.game import GLMOptimizationConfig
+    from photon_ml_tpu.ops.regularization import RegularizationContext
+    from photon_ml_tpu.optimize import OptimizerConfig, OptimizerType
+    from photon_ml_tpu.parallel import make_mesh
+    from photon_ml_tpu.testing import generate_mixed_effect_data
+    from photon_ml_tpu.testing.generators import mixed_data_to_raw_dataset
+
+    n_model = 2 if n_devices % 2 == 0 else 1
+    mesh = make_mesh(n_data=n_devices // n_model, n_model=n_model)
+    n_rows = 16 * n_devices
+    data = generate_mixed_effect_data(
+        n=n_rows,
+        d_fixed=8,
+        re_specs={"userId": (2 * n_devices, 4), "itemId": (n_devices, 3)},
+        seed=0,
+    )
+    raw = mixed_data_to_raw_dataset(data)
+
+    def cfg(opt_type=OptimizerType.LBFGS):
+        return GLMOptimizationConfig(
+            optimizer=OptimizerConfig(
+                optimizer_type=opt_type, tolerance=1e-6, max_iterations=3
+            ),
+            regularization=RegularizationContext("L2"),
+            reg_weight=1.0,
+        )
+
+    n_cd = 2
+
+    def fit():
+        est = GameEstimator(
+            task="logistic_regression",
+            coordinate_configs=[
+                CoordinateConfig(
+                    name="global",
+                    feature_shard="global",
+                    config=cfg(OptimizerType.TRON),
+                    layout="tiled",
+                ),
+                CoordinateConfig(
+                    name="per-user",
+                    feature_shard="userShard",
+                    config=cfg(),
+                    random_effect_type="userId",
+                ),
+                CoordinateConfig(
+                    name="per-item",
+                    feature_shard="itemShard",
+                    config=cfg(),
+                    random_effect_type="itemId",
+                ),
+            ],
+            n_cd_iterations=n_cd,
+            mesh=mesh,
+        )
+        model = est.fit(raw)[-1].model
+        for name in ("global", "per-user", "per-item"):
+            m = model[name]
+            arr = m.coef_values if hasattr(m, "coef_values") else (
+                m.model.coefficients.means
+            )
+            np.asarray(arr)
+
+    fit()  # compile warmup at this exact mesh/shape
+    t0 = time.perf_counter()
+    fit()
+    wall = time.perf_counter() - t0
+    return {
+        "n_devices": n_devices,
+        "rows": n_rows,
+        "wall_sec": round(wall, 4),
+        "examples_per_sec_per_chip": round(
+            n_rows * n_cd / max(wall, 1e-9) / n_devices, 1
+        ),
+    }
+
+
+def bench_multichip(mesh_sizes=(1, 2, 4, 8)) -> dict:
+    """MULTICHIP_r05 dryrun shapes swept across virtual CPU mesh sizes:
+    examples/sec/chip vs mesh size under weak scaling (the problem grows
+    with the mesh, so flat per-chip throughput = ideal scaling; the CPU
+    backend timeshares one core across the virtual devices, so the absolute
+    numbers only rank mesh overheads, not real chip throughput).
+
+    Each size runs in its own subprocess because the virtual device count is
+    fixed at backend init; the parent never imports JAX for this config.
+
+    value = examples/sec/chip at the LARGEST mesh; vs_baseline = largest-mesh
+    per-chip rate / single-device per-chip rate (per-chip efficiency kept as
+    the mesh grows); per-size rates land in ``quadrants.mesh`` for --diff."""
+    import subprocess
+    import sys
+
+    rows = {}
+    for nd in mesh_sizes:
+        env = dict(os.environ, JAX_PLATFORMS="cpu")
+        proc = subprocess.run(
+            [sys.executable, os.path.abspath(__file__),
+             "--multichip-child", str(nd)],
+            capture_output=True, text=True, env=env,
+        )
+        if proc.returncode != 0:
+            raise RuntimeError(
+                f"multichip child (n_devices={nd}) failed:\n{proc.stderr[-2000:]}"
+            )
+        rows[nd] = json.loads(proc.stdout.strip().splitlines()[-1])
+    largest, smallest = rows[max(mesh_sizes)], rows[min(mesh_sizes)]
+    per_size = ", ".join(
+        f"{nd}dev {rows[nd]['examples_per_sec_per_chip']:.0f} ex/s/chip "
+        f"({rows[nd]['wall_sec']:.2f}s wall, {rows[nd]['rows']} rows)"
+        for nd in mesh_sizes
+    )
+    return {
+        "metric": "multichip_examples_per_sec_per_chip",
+        "value": largest["examples_per_sec_per_chip"],
+        "unit": (
+            "examples/sec/chip at the largest virtual mesh (weak scaling: "
+            "rows=16*devices, d_fixed=8, userId/itemId REs scale with the "
+            "mesh; tiled TRON global + two LBFGS REs, 2 CD sweeps; "
+            f"per-size: {per_size}; vs_baseline = largest-mesh per-chip "
+            "rate / 1-device per-chip rate)"
+        ),
+        "vs_baseline": round(
+            largest["examples_per_sec_per_chip"]
+            / max(smallest["examples_per_sec_per_chip"], 1e-9),
+            2,
+        ),
+        "quadrants": {
+            "mesh": {
+                f"n{nd}_examples_per_sec_per_chip": rows[nd][
+                    "examples_per_sec_per_chip"
+                ]
+                for nd in mesh_sizes
+            }
+        },
     }
 
 
@@ -938,15 +1132,24 @@ def load_bench_record(path: str) -> dict:
 
 def _lower_is_better(name: str) -> bool:
     """Direction of improvement from the series name: wall/latency seconds
-    regress upward, throughput (examples/sec, scores/sec, GB/s) downward."""
+    regress upward, throughput (examples/sec, scores/sec, GB/s) and overlap
+    factors/ratios downward (more of the stage wall hidden = better)."""
     n = name.lower()
-    if "per_sec" in n or "/s" in n:
+    if "per_sec" in n or "/s" in n or "overlap" in n:
         return False
     return n.endswith("_sec") or n.endswith("_seconds") or "latency" in n or "wall" in n
 
 
 def _diff_one(name: str, old_v: float, new_v: float, tolerance: float) -> dict:
     lower_better = _lower_is_better(name)
+    # direction self-check: an overlap series that ever classifies as
+    # lower-is-better would flag pipelining IMPROVEMENTS as regressions —
+    # fail the diff loudly instead of inverting the gate
+    if "overlap" in name.lower() and lower_better:
+        raise AssertionError(
+            f"--diff direction check: overlap series {name!r} must be "
+            "higher-is-better"
+        )
     if old_v == 0:
         delta = 0.0 if new_v == 0 else float("inf")
     else:
@@ -1033,8 +1236,26 @@ def main(argv: Optional[List[str]] = None):
     p = argparse.ArgumentParser()
     p.add_argument(
         "--config",
-        choices=["glmix", "sparse", "billion", "tiled", "hbm", "streamed-fe", "serving"],
+        choices=[
+            "glmix", "sparse", "billion", "tiled", "hbm", "streamed-fe",
+            "serving", "multichip",
+        ],
         default="glmix",
+    )
+    p.add_argument(
+        "--multichip-child",
+        type=int,
+        default=None,
+        metavar="N_DEVICES",
+        help=argparse.SUPPRESS,  # internal: one mesh size of --config multichip
+    )
+    p.add_argument(
+        "--pipeline-depth",
+        type=int,
+        default=2,
+        help="streamed-fe config only: sweep pipelining depth for the "
+        "streamed solve (1 = serial double buffer, >= 2 overlaps slice "
+        "staging with result collection; bit-identical coefficients)",
     )
     p.add_argument(
         "--n",
@@ -1108,6 +1329,13 @@ def main(argv: Optional[List[str]] = None):
         print(json.dumps(summary_metric(a.read_summary)))
         return
 
+    if a.multichip_child is not None:
+        print(json.dumps(_bench_multichip_child(a.multichip_child)))
+        return
+    if a.config == "multichip":
+        print(json.dumps(bench_multichip()))
+        return
+
     if a.config == "sparse":
         print(json.dumps(bench_sparse_huge_d()))
         return
@@ -1121,7 +1349,13 @@ def main(argv: Optional[List[str]] = None):
         print(json.dumps(bench_hbm_attribution()))
         return
     if a.config == "streamed-fe":
-        print(json.dumps(bench_streamed_fe(n=min(a.n, 200_000))))
+        print(
+            json.dumps(
+                bench_streamed_fe(
+                    n=min(a.n, 200_000), pipeline_depth=a.pipeline_depth
+                )
+            )
+        )
         return
     if a.config == "serving":
         print(json.dumps(bench_serving()))

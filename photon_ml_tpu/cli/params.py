@@ -204,6 +204,29 @@ def parse_mesh_shape(spec: Optional[str]):
     return make_mesh(n_data=n_data, n_model=n_model)
 
 
+def parse_pipeline_depth(value) -> int:
+    """``--pipeline-depth N`` -> validated sweep pipelining depth (>= 1)."""
+    depth = int(value)
+    if depth < 1:
+        raise ValueError(f"pipeline depth must be >= 1: {depth}")
+    return depth
+
+
+def check_pipeline_composition(depth: int, distributed: bool) -> None:
+    """Refuse the illegal pipelining compositions up front (support-matrix
+    ledger). Multi-process training issues collectives that every host must
+    enter in the same order; a background eval/staging lane would let that
+    order diverge per host and deadlock the mesh — refused until the lanes
+    are made collective-aware."""
+    if depth > 1 and distributed:
+        raise ValueError(
+            f"pipeline.depth={depth} is not supported with --distributed "
+            "(multi-process collectives must be entered in one global order; "
+            "background pipeline lanes would reorder them per host); use "
+            "pipeline.depth=1"
+        )
+
+
 def build_shard_configs(args) -> Dict[str, FeatureShardConfig]:
     shards: Dict[str, FeatureShardConfig] = {}
     for spec in args.feature_shard:

@@ -44,9 +44,11 @@ from ..utils.stats import compute_feature_statistics, save_feature_statistics
 from .params import (
     add_common_io_args,
     build_shard_configs,
+    check_pipeline_composition,
     parse_coordinate,
     parse_input_columns,
     parse_mesh_shape,
+    parse_pipeline_depth,
     plan_host_row_split,
     resolve_input_paths,
 )
@@ -111,6 +113,16 @@ def build_parser() -> argparse.ArgumentParser:
         help="additionally reject a coordinate update whose training loss "
         "regresses more than this above the coordinate's last accepted "
         "loss (default: finiteness-only rejection)",
+    )
+    p.add_argument(
+        "--pipeline-depth",
+        type=parse_pipeline_depth,
+        default=1,
+        help="sweep pipelining depth (pipeline.depth): 1 = serial loop "
+        "(default); >= 2 overlaps host staging, device solves and "
+        "validation eval across coordinates with bit-identical accepted "
+        "models, ledger and checkpoints (game/pipeline.py). Not supported "
+        "with --distributed",
     )
     p.add_argument("--output-dir", required=True)
     p.add_argument(
@@ -259,6 +271,8 @@ def run(argv: Optional[List[str]] = None) -> Dict:
 
     enable_persistent_compilation_cache()
 
+    # refuse illegal pipelining compositions before any expensive setup
+    check_pipeline_composition(args.pipeline_depth, bool(args.distributed))
 
     if args.distributed:
         if args.distributed == "auto":
@@ -510,6 +524,7 @@ def _run_training(args, run_t, metric_sinks, t_run0, recorder=None) -> Dict:
         validation_frequency=args.validation_frequency,
         divergence_guard=not args.no_divergence_guard,
         rejection_tolerance=args.coordinate_rejection_tolerance,
+        pipeline_depth=args.pipeline_depth,
     )
     for sink in metric_sinks:
         # estimator lifecycle events (TrainingStart/OptimizationLog/Finish)
@@ -755,6 +770,7 @@ def _run_tuning(args, estimator, raw, validation, coords, prior_results,
             validation_frequency=estimator.validation_frequency,
             divergence_guard=estimator.divergence_guard,
             rejection_tolerance=estimator.rejection_tolerance,
+            pipeline_depth=estimator.pipeline_depth,
         )
         r = est.fit(
             raw, validation=validation,

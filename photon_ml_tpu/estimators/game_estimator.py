@@ -114,6 +114,7 @@ class GameEstimator(EventEmitter):
         validation_frequency: str = "COORDINATE",
         divergence_guard: bool = True,
         rejection_tolerance: Optional[float] = None,
+        pipeline_depth: int = 1,
     ):
         super().__init__()
         if not coordinate_configs:
@@ -133,6 +134,11 @@ class GameEstimator(EventEmitter):
         # CoordinateDescent (see game/descent.py for semantics)
         self.divergence_guard = divergence_guard
         self.rejection_tolerance = rejection_tolerance
+        # sweep pipelining depth (game/pipeline.py): 1 = serial; >= 2 runs
+        # eval on a background lane and lets the streamed paths prefetch
+        if pipeline_depth < 1:
+            raise ValueError(f"pipeline_depth must be >= 1: {pipeline_depth}")
+        self.pipeline_depth = int(pipeline_depth)
         if mesh is not None and entity_pad_multiple == 1:
             # entity blocks shard over the data axis: pad to its size
             from ..parallel.mesh import DATA_AXIS
@@ -455,6 +461,7 @@ class GameEstimator(EventEmitter):
                 resume_state=resume_state if combo_index == 0 else None,
                 divergence_guard=self.divergence_guard,
                 rejection_tolerance=self.rejection_tolerance,
+                pipeline_depth=self.pipeline_depth,
             )
             with timed(f"train config {reg_weights}", logging.INFO):
                 out = cd.run(initial_models=prev_models)

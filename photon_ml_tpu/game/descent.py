@@ -14,6 +14,7 @@ fullOuterJoin RDD arithmetic is elementwise adds (SURVEY.md §2.1 P7).
 
 from __future__ import annotations
 
+import collections
 import dataclasses
 import logging
 from typing import Dict, List, Mapping, Optional, Sequence, Tuple
@@ -28,6 +29,7 @@ from ..evaluation.suite import EvaluationResults, EvaluationSuite
 from ..models.game import GameModel
 from ..optimize.trackers import build_tracker, record_tracker_metrics
 from ..utils.timed import timed
+from . import pipeline
 from .coordinate import Coordinate, ModelCoordinate
 
 logger = logging.getLogger("photon_ml_tpu")
@@ -102,6 +104,7 @@ class CoordinateDescent:
         resume_state: Optional[object] = None,
         divergence_guard: bool = True,
         rejection_tolerance: Optional[float] = None,
+        pipeline_depth: int = 1,
     ):
         """``checkpoint_fn(iteration, models)`` runs after each completed
         sweep (crash recovery for long runs: resume = warm-start from the
@@ -139,7 +142,17 @@ class CoordinateDescent:
         zero-fetch sweep. ``rejection_tolerance``: additionally reject when
         the update's train loss regresses more than this above the
         coordinate's last accepted loss (None — the default — disables the
-        regression check; divergence rejection is purely about finiteness)."""
+        regression check; divergence rejection is purely about finiteness).
+
+        ``pipeline_depth``: async-dispatch lookahead across the three sweep
+        lanes (host staging, device solve, device score/eval). Depth 1 (the
+        default) is exactly the serial loop. Depth >= 2 dispatches the
+        accepted-score sum before the divergence guard's fetch, runs
+        validation evaluations on a background lane (up to ``depth - 1`` in
+        flight), and lets the streaming layers prefetch their next slice
+        while a solve is in flight — all drained back in submit order, so
+        accepted bits, the accept/reject ledger, and every boundary state
+        handed to ``boundary_fn`` are identical to depth 1."""
         if not coordinates:
             raise ValueError("CoordinateDescent needs at least one coordinate")
         if n_iterations < 1:
@@ -156,6 +169,8 @@ class CoordinateDescent:
             raise ValueError(
                 f"rejection_tolerance must be >= 0: {rejection_tolerance}"
             )
+        if pipeline_depth < 1:
+            raise ValueError(f"pipeline_depth must be >= 1: {pipeline_depth}")
         self.coordinates = dict(coordinates)
         self.order = list(coordinates)
         self.n_iterations = n_iterations
@@ -166,6 +181,7 @@ class CoordinateDescent:
         self.resume_state = resume_state
         self.divergence_guard = divergence_guard
         self.rejection_tolerance = rejection_tolerance
+        self.pipeline_depth = int(pipeline_depth)
         n_trainable = sum(
             0 if isinstance(c, ModelCoordinate) else 1 for c in self.coordinates.values()
         )
@@ -234,14 +250,48 @@ class CoordinateDescent:
 
         for it in range(start_it, self.n_iterations):
             first = start_idx if it == start_it else 0
-            with obs.span("cd.sweep", iteration=it):
+            with obs.span(
+                "cd.sweep", iteration=it, pipeline_depth=self.pipeline_depth
+            ) as sweep_span:
+                # background eval lane (depth >= 2, per-coordinate
+                # validation): coordinate k's eval overlaps coordinate k+1's
+                # solve; results drain in submit order, so the evaluation
+                # ledger and best-model choices are the serial loop's
+                lane = None
+                lane_snaps: collections.deque = collections.deque()
+                if (
+                    self.pipeline_depth > 1
+                    and self.validation is not None
+                    and self.validation_frequency == "COORDINATE"
+                ):
+                    lane = pipeline.EvalLane(
+                        self._evaluate,
+                        capacity=self.pipeline_depth - 1,
+                        anchor=sweep_span,
+                    )
+
+                def _absorb(drained):
+                    nonlocal best_eval, best_models
+                    for eit, ename, res in drained:
+                        best_eval, best_models = self._absorb_eval(
+                            eit,
+                            ename,
+                            res,
+                            lane_snaps.popleft(),
+                            evaluations,
+                            best_eval,
+                            best_models,
+                        )
+
                 # zero-fetch invariant, runtime-enforced: inside the sweep
                 # every device->host transfer must be an explicit
                 # jax.device_get (logged_fetch) — an implicit fetch
                 # (float(arr), np.asarray(arr), arr.item()) raises instead of
                 # silently stalling the device pipeline. The static half of
                 # this contract is photon_ml_tpu.analysis rule R1.
-                with transfer_guard():
+                with pipeline.pipelined(
+                    self.pipeline_depth, anchor=sweep_span
+                ), pipeline.closing(lane), transfer_guard():
                     for idx in range(first, len(self.order)):
                         name = self.order[idx]
                         coordinate = coords[name]
@@ -302,6 +352,18 @@ class CoordinateDescent:
                                 new_scores = faults.corrupt(
                                     "coordinate.scores", new_scores
                                 )
+                            # depth >= 2: dispatch the accepted-score sum
+                            # BEFORE the guard's blocking fetch — async
+                            # dispatch queues the add behind the scores, the
+                            # fetch overlaps it, and a rejection simply drops
+                            # the candidate (models/scores/summed untouched,
+                            # same op and operands as the serial add →
+                            # bit-identical on accept)
+                            candidate = (
+                                residual + new_scores
+                                if self.pipeline_depth > 1
+                                else None
+                            )
                             accepted, train_loss = (
                                 self._guard(
                                     name, new_scores, solver_result, train_losses
@@ -312,7 +374,11 @@ class CoordinateDescent:
                             if accepted:
                                 models[name] = model
                                 # summedScores - oldScores + newScores (:441-446)
-                                summed = residual + new_scores
+                                summed = (
+                                    candidate
+                                    if candidate is not None
+                                    else residual + new_scores
+                                )
                                 scores[name] = new_scores
                                 if train_loss is not None:
                                     train_losses[name] = train_loss
@@ -337,9 +403,15 @@ class CoordinateDescent:
                                     self.validation is not None
                                     and self.validation_frequency == "COORDINATE"
                                 ):
-                                    best_eval, best_models = self._track_best(
-                                        models, evaluations, best_eval, best_models, it, name
-                                    )
+                                    if lane is not None:
+                                        snapshot = dict(models)
+                                        lane_snaps.append(snapshot)
+                                        lane.submit(it, name, snapshot)
+                                        _absorb(lane.drain_ready())
+                                    else:
+                                        best_eval, best_models = self._track_best(
+                                            models, evaluations, best_eval, best_models, it, name
+                                        )
                             else:
                                 # quarantine the update: models / scores /
                                 # summed were never touched, so the sweep
@@ -355,6 +427,11 @@ class CoordinateDescent:
                             # reachable. Serialization fetches device arrays,
                             # so lift the transfer guard for exactly this call
                             # — a checkpoint is a deliberate sync point.
+                            # In-flight evals drain first: the boundary state
+                            # must embed the same evaluations/best ledger the
+                            # serial loop would have at this exact update.
+                            if lane is not None:
+                                _absorb(lane.drain_all())
                             with allow_transfers(), obs.span(
                                 "cd.checkpoint", phase="checkpoint", coordinate=name
                             ):
@@ -374,6 +451,11 @@ class CoordinateDescent:
                                         train_losses=dict(train_losses),
                                     )
                                 )
+                    if lane is not None:
+                        # sweep end is a serial point: everything submitted
+                        # this sweep lands in the ledger before the sweep
+                        # span closes (and before any sweep checkpoint)
+                        _absorb(lane.drain_all())
                     if self.validation is not None and self.validation_frequency == "SWEEP":
                         best_eval, best_models = self._track_best(
                             models, evaluations, best_eval, best_models, it, self.order[-1]
@@ -452,18 +534,27 @@ class CoordinateDescent:
     def _track_best(self, models, evaluations, best_eval, best_models, it, name):
         with obs.span("cd.eval", phase="eval", iteration=it, coordinate=name):
             res = self._evaluate(models)
+        return self._absorb_eval(
+            it, name, res, models, evaluations, best_eval, best_models
+        )
+
+    def _absorb_eval(self, it, name, res, snapshot, evaluations, best_eval, best_models):
+        """Fold one evaluation result into the ledger: the serial loop calls
+        this right after evaluating; the pipelined loop calls it when the
+        eval lane drains (same submit order → same ledger). ``snapshot`` is
+        the models dict AS OF the evaluated update."""
         evaluations.append((name, res))
         primary = self.validation.suite.primary
         # only snapshots with every coordinate trained are candidates for
         # "best model" — a mid-first-sweep partial model is not a valid GAME
         # model
-        complete = len(models) == len(self.order)
+        complete = len(snapshot) == len(self.order)
         if complete and (
             best_eval is None
             or primary.better(res.primary_metric, best_eval.primary_metric)
         ):
             best_eval = res
-            best_models = dict(models)
+            best_models = dict(snapshot)
         if obs.active():
             # res.metrics values are already host floats — no extra fetch
             gauge = obs.current_run().registry.gauge(

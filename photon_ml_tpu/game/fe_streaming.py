@@ -53,6 +53,8 @@ import numpy as np
 
 from .. import obs
 from ..analysis.runtime import logged_fetch
+from ..utils.futures import PrefetchQueue
+from . import pipeline
 from ..ops.features import FeatureMatrix, LabeledBatch
 from ..ops.glm import (
     finalize_hessian_vector,
@@ -177,6 +179,7 @@ class StreamedFEObjective:
         prior_mean: Optional[Array] = None,
         prior_precision: Optional[Array] = None,
         residual_scores: Optional[Array] = None,  # device f[n] or None
+        pipeline_depth: Optional[int] = None,  # None -> pipeline.active_depth()
     ):
         self.loss = loss
         self.hb = host_batch
@@ -256,12 +259,57 @@ class StreamedFEObjective:
             "stage_seconds": 0.0,
         }
 
+        # sweep pipelining (game/pipeline.py): depth >= 2 moves staging onto
+        # a background thread whose queue is bounded by the SAME byte budget
+        # (queued + held slice bytes <= budget_bytes, queue-empty admits one
+        # — the inline double buffer's 2-resident worst case, so slice
+        # geometry and the left-to-right accumulation bits never change).
+        # The stager cycles 0..n_slices-1 forever: every pass (vg and hvp)
+        # consumes slices in that exact order, so the NEXT pass's slice 0 is
+        # already staged while this pass's finalize fetch is in flight.
+        self.pipeline_depth = (
+            pipeline.active_depth() if pipeline_depth is None else int(pipeline_depth)
+        )
+        self._anchor = pipeline.stage_anchor()
+        self._slice_cost = self.step * row_bytes
+        self._prefetch: Optional[PrefetchQueue] = None
+        # (start, end) host wall intervals behind photon_stream_overlap_ratio:
+        # "pass" covers each dispatch loop (kernels for earlier slices are in
+        # flight the whole time under async dispatch), "collect" the blocking
+        # result fetch — together the host-observable compute shadow
+        self._intervals = {"stage": [], "collect": [], "pass": []}
+
     # -- staging --------------------------------------------------------------
 
-    def _stage_features(self, k: int) -> FeatureMatrix:
+    def _acquire(self, k: int) -> FeatureMatrix:
+        """Slice k's staged features: inline at depth 1, popped from the
+        background stager at depth >= 2 (started lazily on first use)."""
+        if self.pipeline_depth <= 1 or self.n_slices <= 1:
+            return self._stage_features(k)
+        if self._prefetch is None:
+            self._prefetch = PrefetchQueue(
+                lambda i: self._stage_features(i, parent=self._anchor),
+                self.n_slices,
+                depth=self.pipeline_depth,
+                cyclic=True,
+                cost=lambda i: self._slice_cost,
+                budget=self.budget_bytes,
+                name="photon-fe-stage",
+            )
+        idx, staged = self._prefetch.get()
+        if idx != k:
+            raise RuntimeError(
+                f"fe_streaming prefetch out of order: staged slice {idx}, "
+                f"consumer wants {k}"
+            )
+        return staged
+
+    def _stage_features(self, k: int, parent: Optional[obs.Span] = None) -> FeatureMatrix:
         """H2D-stage slice k's feature planes (dispatched before the previous
-        slice's partials are consumed, so the copy overlaps compute)."""
-        with obs.span("fe_stream.stage", phase="stage", slice=k) as sp:
+        slice's partials are consumed, so the copy overlaps compute). On the
+        stager thread ``parent`` anchors the span under the sweep — the
+        contextvar ancestry does not cross threads."""
+        with obs.span("fe_stream.stage", parent=parent, phase="stage", slice=k) as sp:
             s0 = k * self.step
             s1 = s0 + self.step
             if self._tail is not None and k == self.n_slices - 1:
@@ -279,6 +327,7 @@ class StreamedFEObjective:
         # duration_s is set when the span closes; route all slice timing
         # through the span so the timeline stays complete (lint rule R7)
         self.stats["stage_seconds"] += sp.duration_s
+        self._intervals["stage"].append((sp.start_perf, sp.start_perf + sp.duration_s))
         obs.current_run().registry.histogram(
             "photon_stream_slice_stage_seconds",
             "host wall per H2D slice-staging dispatch",
@@ -289,28 +338,45 @@ class StreamedFEObjective:
 
     # -- objective ------------------------------------------------------------
 
-    def value_and_grad(self, w: np.ndarray):
-        """One streamed pass: (objective value, gradient) as host numpy."""
+    def _collect(self, kind: str, out):
+        """The pass's single blocking fetch, wrapped in a phase="collect"
+        span so the overlap ratio can measure staging hidden under it."""
+        with obs.span("fe_stream.collect", phase="collect", kind=kind) as cp:
+            out = logged_fetch("fe_streaming.collect", out)
+        self._intervals["collect"].append((cp.start_perf, cp.start_perf + cp.duration_s))
+        return out
+
+    def value_and_grad_deferred(self, w: np.ndarray):
+        """Dispatch one streamed (value, grad) pass WITHOUT fetching; returns
+        a zero-arg closure that fetches the result. Async dispatch means the
+        device is already chewing on this pass while the caller dispatches
+        the next one (host_driver overlaps the tolerance pass with the first
+        real evaluation this way) — and at depth >= 2 the background stager
+        is meanwhile staging the next pass's slices."""
         coef = jnp.asarray(w, self.sdt)
         eff, mshift = self.norm.effective_coefficients(coef)
         self.stats["vg_passes"] += 1
-        with obs.span("fe_stream.pass", kind="vg", n_slices=self.n_slices):
+        with obs.span("fe_stream.pass", kind="vg", n_slices=self.n_slices) as pp:
             acc = None
-            staged = self._stage_features(0)
+            staged = self._acquire(0)
             for k in range(self.n_slices):
                 labels, offsets, weights = self._scalar_slices[k]
                 part = _vg_slice_kernel(
                     self.loss, staged, labels, offsets, weights, eff, mshift
                 )
                 if k + 1 < self.n_slices:
-                    staged = self._stage_features(k + 1)  # overlaps slice k
+                    staged = self._acquire(k + 1)  # overlaps slice k
                 # fixed left-to-right accumulation: bitwise-stable run-to-run
                 acc = part if acc is None else tuple(a + p for a, p in zip(acc, part))
             value, grad = _finalize_vg_kernel(
                 coef, acc[0], acc[1], acc[2], self.norm, self._l2, self._pm, self._pp
             )
-            value, grad = logged_fetch("fe_streaming.collect", (value, grad))
-        return value, grad
+        self._intervals["pass"].append((pp.start_perf, pp.start_perf + pp.duration_s))
+        return lambda: self._collect("vg", (value, grad))
+
+    def value_and_grad(self, w: np.ndarray):
+        """One streamed pass: (objective value, gradient) as host numpy."""
+        return self.value_and_grad_deferred(w)()
 
     def hessian_vector(self, w: np.ndarray, v: np.ndarray) -> np.ndarray:
         """One streamed pass of H(w) v (the TRON inner-CG kernel)."""
@@ -319,9 +385,9 @@ class StreamedFEObjective:
         eff, mshift = self.norm.effective_coefficients(coef)
         eff_v, vshift = self.norm.effective_coefficients(vv)
         self.stats["hvp_passes"] += 1
-        with obs.span("fe_stream.pass", kind="hvp", n_slices=self.n_slices):
+        with obs.span("fe_stream.pass", kind="hvp", n_slices=self.n_slices) as pp:
             acc = None
-            staged = self._stage_features(0)
+            staged = self._acquire(0)
             for k in range(self.n_slices):
                 labels, offsets, weights = self._scalar_slices[k]
                 part = _hvp_slice_kernel(
@@ -329,11 +395,19 @@ class StreamedFEObjective:
                     eff, mshift, eff_v, vshift,
                 )
                 if k + 1 < self.n_slices:
-                    staged = self._stage_features(k + 1)
+                    staged = self._acquire(k + 1)
                 acc = part if acc is None else tuple(a + p for a, p in zip(acc, part))
             hv = _finalize_hvp_kernel(vv, acc[0], acc[1], self.norm, self._l2, self._pp)
-            (hv,) = logged_fetch("fe_streaming.collect", (hv,))
+        self._intervals["pass"].append((pp.start_perf, pp.start_perf + pp.duration_s))
+        (hv,) = self._collect("hvp", (hv,))
         return hv
+
+    def close(self) -> None:
+        """Stop the background stager (idempotent; depth-1 objectives have
+        nothing to stop). An in-flight device_put completes harmlessly."""
+        if self._prefetch is not None:
+            self._prefetch.close()
+            self._prefetch = None
 
     # -- metrics --------------------------------------------------------------
 
@@ -374,6 +448,30 @@ class StreamedFEObjective:
         reg.gauge(
             "photon_stream_solve_seconds", "wall of the whole streamed solve"
         ).labels(site=site).set(solve_seconds)
+        # measured (not inferred) overlap: fraction of staging wall that ran
+        # concurrently with the compute shadow (dispatch-loop pass windows,
+        # where async-dispatched slice kernels are in flight, plus the
+        # blocking collect fetch). One source of truth, shared with the
+        # timeline's phase math (obs.timeline.overlap_ratio). Inline staging
+        # (depth 1) executes ON the solve thread inside those same windows —
+        # serial with the compute it sits between, so the serial double
+        # buffer scores exactly 0 rather than a self-overlap 1.0.
+        if self.pipeline_depth <= 1 or self._prefetch is None:
+            measured_overlap = 0.0
+        else:
+            measured_overlap = obs.overlap_ratio(
+                self._intervals["stage"],
+                self._intervals["pass"] + self._intervals["collect"],
+            )
+        reg.gauge(
+            "photon_stream_overlap_ratio",
+            "fraction of staging wall overlapped with in-flight compute",
+        ).labels(site=site).set(measured_overlap)
+        if self._prefetch is not None:
+            reg.gauge(
+                "photon_stream_inflight_peak_bytes",
+                "peak staged bytes in flight (queued + held), bounded by the budget",
+            ).labels(site=site).set(self._prefetch.peak_inflight)
 
 
 def score_streamed_fe(

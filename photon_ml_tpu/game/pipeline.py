@@ -1,0 +1,187 @@
+"""Sweep-level pipelining: async-dispatch depth plumbing and the eval lane.
+
+The CD loop has three independent resource lanes — host staging/H2D, device
+solve, and device score/eval — that the serial sweep runs strictly in order
+(PR 7's timeline profiler scores it an ``overlap_factor`` of exactly 0).
+This module is the coordination layer that lets them overlap without
+changing a single accepted bit:
+
+- :func:`pipelined` / :func:`active_depth` / :func:`stage_anchor` carry the
+  sweep's pipeline depth and anchor span down to the streaming layers
+  (``fe_streaming`` / ``streaming``) through a contextvar, so
+  ``descent.run`` does not have to thread a knob through every coordinate
+  signature. Depth 1 — the default everywhere — means "exactly the serial
+  loop"; the streaming layers only start background staging at depth >= 2.
+- :class:`EvalLane` runs validation evaluations on a single daemon worker
+  in submit order, bounded by ``capacity`` in-flight snapshots, so
+  coordinate k's eval overlaps coordinate k+1's solve. Results are drained
+  in FIFO order — the same order the serial loop produced them — which is
+  what keeps the best-model comparisons and the evaluation ledger
+  bit-identical to depth 1.
+
+Worker-thread spans are parented explicitly on the sweep's anchor span
+(contextvar ancestry does not cross threads); that keeps them OUTERMOST
+phase spans in ``obs.timeline.phase_attribution`` so the overlap they buy
+is the overlap the instrument reports.
+"""
+
+from __future__ import annotations
+
+import collections
+import contextlib
+import contextvars
+import threading
+from typing import Callable, List, Optional, Tuple
+
+from .. import obs
+
+_ctx: contextvars.ContextVar = contextvars.ContextVar(
+    "photon_pipeline", default=None
+)
+
+
+@contextlib.contextmanager
+def pipelined(depth: int, anchor: Optional[obs.Span] = None):
+    """Declare a pipelined region of ``depth`` (>= 1); streaming layers
+    constructed inside pick the depth up via :func:`active_depth` and parent
+    their worker-thread spans on ``anchor`` (normally the sweep span)."""
+    if depth < 1:
+        raise ValueError(f"pipeline depth must be >= 1: {depth}")
+    token = _ctx.set((int(depth), anchor))
+    try:
+        yield
+    finally:
+        _ctx.reset(token)
+
+
+def active_depth() -> int:
+    state = _ctx.get()
+    return state[0] if state is not None else 1
+
+
+def stage_anchor() -> Optional[obs.Span]:
+    state = _ctx.get()
+    return state[1] if state is not None else None
+
+
+@contextlib.contextmanager
+def closing(lane: Optional["EvalLane"]):
+    """Close ``lane`` on exit (None is fine) — keeps the sweep's combined
+    ``with`` line flat instead of a try/finally around the whole body."""
+    try:
+        yield lane
+    finally:
+        if lane is not None:
+            lane.close()
+
+
+class EvalLane:
+    """Ordered background evaluation lane for the CD sweep.
+
+    One daemon worker runs ``fn(snapshot)`` per submitted task strictly in
+    submit order; :meth:`submit` blocks while ``capacity`` tasks are in
+    flight (bounding how many model snapshots stay alive). The consumer
+    drains ``(iteration, coordinate, result)`` triples — :meth:`drain_ready`
+    without blocking, :meth:`drain_all` before any point that must observe
+    the same state as the serial loop (checkpoint boundaries, sweep end).
+    A worker exception is parked in order and re-raised at the drain that
+    would have returned its result, after which the lane is closed."""
+
+    def __init__(
+        self,
+        fn: Callable[[dict], object],
+        capacity: int,
+        anchor: Optional[obs.Span] = None,
+        name: str = "photon-eval",
+    ):
+        if capacity < 1:
+            raise ValueError(f"eval lane capacity must be >= 1: {capacity}")
+        self._fn = fn
+        self._capacity = int(capacity)
+        self._anchor = anchor
+        self._tasks: collections.deque = collections.deque()
+        # (iteration, coordinate, result, error) in submit order
+        self._done: collections.deque = collections.deque()
+        self._inflight = 0
+        self._closed = False
+        self._cv = threading.Condition()
+        self._thread = threading.Thread(target=self._work, name=name, daemon=True)
+        self._thread.start()
+
+    def _work(self) -> None:
+        while True:
+            with self._cv:
+                while not self._tasks and not self._closed:
+                    self._cv.wait()
+                if self._closed:
+                    return
+                it, coord, snapshot = self._tasks.popleft()
+            try:
+                with obs.span(
+                    "cd.eval",
+                    parent=self._anchor,
+                    phase="eval",
+                    iteration=it,
+                    coordinate=coord,
+                ):
+                    result, error = self._fn(snapshot), None
+            # photon: ignore[R4] — parked, re-raised at the matching drain
+            except BaseException as e:
+                result, error = None, e
+            with self._cv:
+                self._done.append((it, coord, result, error))
+                self._cv.notify_all()
+                if error is not None:
+                    self._closed = True
+                    return
+
+    def submit(self, iteration: int, coordinate: str, snapshot: dict) -> None:
+        """Queue ``fn(snapshot)``; blocks while ``capacity`` results are
+        still unconsumed (submitted but not yet drained)."""
+        with self._cv:
+            while (
+                not self._closed
+                and self._inflight - len(self._done) >= self._capacity
+            ):
+                self._cv.wait()
+            if self._closed and not self._done:
+                raise RuntimeError("EvalLane is closed")
+            self._inflight += 1
+            self._tasks.append((iteration, coordinate, snapshot))
+            self._cv.notify_all()
+
+    def _pop_done(self) -> Tuple[int, str, object]:
+        it, coord, result, error = self._done.popleft()
+        self._inflight -= 1
+        if error is not None:
+            raise error
+        return it, coord, result
+
+    def drain_ready(self) -> List[Tuple[int, str, object]]:
+        """Completed results so far, in submit order; never blocks."""
+        out: List[Tuple[int, str, object]] = []
+        with self._cv:
+            while self._done:
+                out.append(self._pop_done())
+            self._cv.notify_all()
+        return out
+
+    def drain_all(self) -> List[Tuple[int, str, object]]:
+        """Block until every submitted task has completed, then return all
+        unconsumed results in submit order."""
+        out: List[Tuple[int, str, object]] = []
+        with self._cv:
+            while self._inflight > 0:
+                while not self._done:
+                    if self._closed and self._inflight > len(self._done):
+                        raise RuntimeError("EvalLane worker died")
+                    self._cv.wait()
+                out.append(self._pop_done())
+            self._cv.notify_all()
+        return out
+
+    def close(self) -> None:
+        with self._cv:
+            self._closed = True
+            self._tasks.clear()
+            self._cv.notify_all()

@@ -274,19 +274,29 @@ class GLMProblem:
             prior_precision=prior_precision,
             residual_scores=residual_scores,
         )
-        with obs.span(
-            "fe_stream.solve",
-            phase="solve",
-            n_slices=obj.n_slices,
-            budget_bytes=int(budget_bytes),
-        ) as solve_span:
-            result = host_optimize(
-                obj.value_and_grad,
-                w0,
-                self.config.solver_config(),
-                hvp=obj.hessian_vector,
-            )
-        obj.record_metrics("fe.train", solve_span.duration_s)
+        try:
+            with obs.span(
+                "fe_stream.solve",
+                phase="solve",
+                n_slices=obj.n_slices,
+                budget_bytes=int(budget_bytes),
+            ) as solve_span:
+                # at pipeline depth >= 2 the driver gets the deferred form
+                # too, so the tolerance pass and the first real evaluation
+                # are both in flight before either is fetched
+                deferred = (
+                    obj.value_and_grad_deferred if obj.pipeline_depth > 1 else None
+                )
+                result = host_optimize(
+                    obj.value_and_grad,
+                    w0,
+                    self.config.solver_config(),
+                    hvp=obj.hessian_vector,
+                    value_and_grad_deferred=deferred,
+                )
+            obj.record_metrics("fe.train", solve_span.duration_s)
+        finally:
+            obj.close()
 
         means = jnp.asarray(result.coefficients, dtype)
         if self.normalization is not None:

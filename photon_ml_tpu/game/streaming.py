@@ -41,6 +41,8 @@ import numpy as np
 from .. import obs
 from ..analysis.runtime import logged_fetch
 from ..optimize import SolverResult
+from ..utils.futures import PrefetchQueue
+from . import pipeline
 
 Array = jax.Array
 
@@ -99,9 +101,18 @@ def solve_streamed(
     budget_bytes: int,
     train_fn,  # _train_blocks or _train_blocks_packed
     solver_kwargs: dict,
+    pipeline_depth: Optional[int] = None,  # None -> pipeline.active_depth()
 ) -> SolverResult:
     """Double-buffered streamed solve over all entity slices; returns a
-    host-materialized SolverResult in entity order (numpy arrays)."""
+    host-materialized SolverResult in entity order (numpy arrays).
+
+    At ``pipeline_depth`` >= 2 staging moves to a background thread bounded
+    by the same byte budget (queued + held slice bytes <= ``budget_bytes``,
+    queue-empty admits one — the inline double buffer's worst case). Slice
+    geometry, dispatch order, and collect order are unchanged, so the
+    outputs are bit-identical to the serial loop."""
+    depth = pipeline.active_depth() if pipeline_depth is None else int(pipeline_depth)
+    anchor = pipeline.stage_anchor()
     E, K, S = blocks_np.features.shape
     feat_itemsize = blocks_np.features.dtype.itemsize
     # solve dtype follows the dataset's labels (features may be narrower):
@@ -125,24 +136,33 @@ def solve_streamed(
             slices.append((s0, s1, kb, sb))
 
     staged_stats = {"total_bytes": 0, "max_slice_bytes": 0}
+    # (start, end) host wall intervals behind photon_stream_overlap_ratio
+    intervals = {"stage": [], "collect": []}
 
-    def stage(sl):
-        s0, s1, kb, sb = sl
-        host = (
-            blocks_np.features[s0:s1, :kb, :sb],
-            blocks_np.labels[s0:s1, :kb],
-            blocks_np.offsets[s0:s1, :kb],
-            blocks_np.weights[s0:s1, :kb],
-            blocks_np.active_rows[s0:s1, :kb],
-            w0_np[s0:s1, :sb],
-            prior_mean_np[s0:s1, :sb],
-            prior_prec_np[s0:s1, :sb],
-        )
-        nbytes = int(sum(a.nbytes for a in host))
-        staged_stats["total_bytes"] += nbytes
-        staged_stats["max_slice_bytes"] = max(staged_stats["max_slice_bytes"], nbytes)
-        obs.add_device_put_bytes("streaming.stage", nbytes)
-        return [jax.device_put(np.ascontiguousarray(a)) for a in host]
+    def stage(sl, parent=None):
+        with obs.span(
+            "re_stream.stage", parent=parent, phase="stage", slice=sl[0]
+        ) as sp:
+            s0, s1, kb, sb = sl
+            host = (
+                blocks_np.features[s0:s1, :kb, :sb],
+                blocks_np.labels[s0:s1, :kb],
+                blocks_np.offsets[s0:s1, :kb],
+                blocks_np.weights[s0:s1, :kb],
+                blocks_np.active_rows[s0:s1, :kb],
+                w0_np[s0:s1, :sb],
+                prior_mean_np[s0:s1, :sb],
+                prior_prec_np[s0:s1, :sb],
+            )
+            nbytes = int(sum(a.nbytes for a in host))
+            staged_stats["total_bytes"] += nbytes
+            staged_stats["max_slice_bytes"] = max(
+                staged_stats["max_slice_bytes"], nbytes
+            )
+            obs.add_device_put_bytes("streaming.stage", nbytes)
+            dev = [jax.device_put(np.ascontiguousarray(a)) for a in host]
+        intervals["stage"].append((sp.start_perf, sp.start_perf + sp.duration_s))
+        return dev
 
     def dispatch(staged):
         feats, labels, offsets, weights, active_rows, w0, pm, pp = staged
@@ -177,13 +197,15 @@ def solve_streamed(
 
     def collect(sl, res):
         s0, s1, _, sb = sl
-        coef, grad, loss, iters, reason, lh, gh = logged_fetch(
-            "streaming.collect",
-            (
-                res.coefficients, res.gradient, res.loss, res.iterations,
-                res.reason, res.loss_history, res.grad_norm_history,
-            ),
-        )
+        with obs.span("re_stream.collect", phase="collect", slice=s0) as cp:
+            coef, grad, loss, iters, reason, lh, gh = logged_fetch(
+                "streaming.collect",
+                (
+                    res.coefficients, res.gradient, res.loss, res.iterations,
+                    res.reason, res.loss_history, res.grad_norm_history,
+                ),
+            )
+        intervals["collect"].append((cp.start_perf, cp.start_perf + cp.duration_s))
         out_coef[s0:s1, :sb] = coef
         out_grad[s0:s1, :sb] = grad
         out_loss[s0:s1] = loss
@@ -207,19 +229,47 @@ def solve_streamed(
         _staged_slice_bytes(s1 - s0, kb, sb) for s0, s1, kb, sb in slices
     )
 
-    with obs.span(
-        "stream.solve", n_slices=len(slices), budget_bytes=int(budget_bytes)
-    ):
-        staged = stage(slices[0])
-        pending = None  # (slice, dispatched result)
-        for i, sl in enumerate(slices):
-            res = dispatch(staged)  # async dispatch on the staged slice
-            if i + 1 < len(slices):
-                staged = stage(slices[i + 1])  # H2D overlaps the running solve
-            if pending is not None:
-                collect(*pending)  # fetch of slice i-1 syncs AFTER i is queued
-            pending = (sl, res)
-        collect(*pending)
+    prefetch = None
+    if depth > 1 and len(slices) > 1:
+        prefetch = PrefetchQueue(
+            lambda i: stage(slices[i], parent=anchor),
+            len(slices),
+            depth=depth,
+            cost=lambda i: _staged_slice_bytes(
+                slices[i][1] - slices[i][0], slices[i][2], slices[i][3]
+            ),
+            budget=budget_bytes,
+            name="photon-re-stage",
+        )
+
+    def acquire(i):
+        if prefetch is None:
+            return stage(slices[i])
+        idx, staged = prefetch.get()
+        if idx != i:
+            raise RuntimeError(
+                f"re streaming prefetch out of order: staged slice {idx}, "
+                f"consumer wants {i}"
+            )
+        return staged
+
+    try:
+        with obs.span(
+            "stream.solve", n_slices=len(slices), budget_bytes=int(budget_bytes)
+        ):
+            staged = acquire(0)
+            pending = None  # (slice, dispatched result)
+            for i, sl in enumerate(slices):
+                res = dispatch(staged)  # async dispatch on the staged slice
+                if i + 1 < len(slices):
+                    staged = acquire(i + 1)  # H2D overlaps the running solve
+                if pending is not None:
+                    collect(*pending)  # fetch of slice i-1 syncs AFTER i is queued
+                pending = (sl, res)
+            collect(*pending)
+    finally:
+        if prefetch is not None:
+            prefetch.close()
 
     reg = obs.current_run().registry
     # site label distinguishes this (entity-sliced RE) path from the
@@ -244,6 +294,17 @@ def solve_streamed(
         "photon_stream_budget_headroom_bytes",
         "budget minus double-buffered peak (negative = over budget)",
     ).labels(site="re.train").set(budget_bytes - 2 * staged_stats["max_slice_bytes"])
+    reg.gauge(
+        "photon_stream_overlap_ratio",
+        "fraction of staging wall overlapped with in-flight compute",
+    ).labels(site="re.train").set(
+        obs.overlap_ratio(intervals["stage"], intervals["collect"])
+    )
+    if prefetch is not None:
+        reg.gauge(
+            "photon_stream_inflight_peak_bytes",
+            "peak staged bytes in flight (queued + held), bounded by the budget",
+        ).labels(site="re.train").set(prefetch.peak_inflight)
 
     return SolverResult(
         coefficients=out_coef,

@@ -423,16 +423,18 @@ def read_avro_dataset_chunked(
     columns: Optional[InputColumnsNames] = None,
     reader_schema=None,
     engine: str = "auto",
+    prefetch_depth: int = 2,
 ) -> Tuple[RawDataset, Dict[str, IndexMap]]:
     """``read_avro_dataset`` with bounded host RSS and pipelined decode.
 
     The monolithic Python path decodes EVERY part file into one record list
     before any columnar conversion — peak host memory is the whole input as
     Python dicts. This reader is the training-data twin of cli/train's
-    background validation decode: it walks part files one at a time, decoding
-    part k+1 on a daemon thread while part k's records convert to columnar
-    arrays, then frees the records. Peak record residency is ~2 parts
-    (one decoding + one converting) instead of all of them, and decode wall
+    background validation decode: it walks part files through a bounded
+    prefetch queue (``prefetch_depth`` parts decoding ahead on a daemon
+    thread, default 2) while the consumer converts the current part to
+    columnar arrays, then frees the records. Peak record residency is
+    ~``prefetch_depth + 1`` parts instead of all of them, and decode wall
     overlaps conversion instead of blocking up front.
 
     When index maps are not supplied, a keys-only first pass (same bounded
@@ -458,9 +460,11 @@ def read_avro_dataset_chunked(
                 engine=engine,
             )
 
-    from ..utils.futures import DaemonFuture
+    from ..utils.futures import PrefetchQueue
     from .avro import list_avro_parts, parse_schema
 
+    if prefetch_depth < 1:
+        raise ValueError(f"prefetch_depth must be >= 1: {prefetch_depth}")
     if reader_schema is not None and not isinstance(reader_schema, tuple):
         reader_schema = parse_schema(reader_schema)
     parts = [part for p in paths for part in list_avro_parts(p)]
@@ -475,17 +479,30 @@ def read_avro_dataset_chunked(
     def _decode(part: str):
         return read_avro_file(part, reader_schema)[1]
 
-    def _pipelined(consume) -> None:
-        """Decode part k+1 in the background while `consume` digests part k."""
-        fut = DaemonFuture(lambda p=parts[0]: _decode(p))
-        for i in range(len(parts)):
-            records = fut.result()
-            if i + 1 < len(parts):
-                fut = DaemonFuture(lambda p=parts[i + 1]: _decode(p))
-            consume(records)
-            del records
-
     from .. import obs
+
+    depth_gauge = obs.current_run().registry.gauge(
+        "photon_ingest_queue_depth",
+        "decoded parts waiting in the chunked reader's prefetch queue",
+    )
+
+    def _pipelined(consume) -> None:
+        """Decode up to ``prefetch_depth`` parts ahead while `consume`
+        digests the current one (order preserved — row order is bit-stable)."""
+        q = PrefetchQueue(
+            lambda i: _decode(parts[i]), len(parts), depth=prefetch_depth,
+            name="photon-bg-decode",
+        )
+        try:
+            for i in range(len(parts)):
+                idx, records = q.get()
+                if idx != i:
+                    raise RuntimeError("chunked reader prefetch out of order")
+                depth_gauge.labels(mode="chunked").set(q.qsize())
+                consume(records)
+                del records
+        finally:
+            q.close()
 
     with obs.span("ingest.chunked", n_parts=len(parts)):
         if index_maps is None:

@@ -40,7 +40,7 @@ from .run import (
     use_run,
 )
 from .sinks import JsonlSink, PrometheusSink
-from .timeline import TimelineRecorder
+from .timeline import TimelineRecorder, interval_overlap_seconds, overlap_ratio
 from .tracing import (
     Span,
     SpanEvent,
@@ -77,6 +77,8 @@ __all__ = [
     "current_span",
     "get_process_index",
     "histogram_quantile",
+    "interval_overlap_seconds",
+    "overlap_ratio",
     "memory_block",
     "read_host_memory",
     "record_solver_metrics",

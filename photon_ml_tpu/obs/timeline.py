@@ -56,6 +56,28 @@ def _union_seconds(intervals: List[Tuple[float, float]]) -> float:
     return total
 
 
+def interval_overlap_seconds(
+    a: List[Tuple[float, float]], b: List[Tuple[float, float]]
+) -> float:
+    """Seconds where the union of ``a`` and the union of ``b`` coincide
+    (inclusion-exclusion over the interval unions)."""
+    return max(0.0, _union_seconds(a) + _union_seconds(b) - _union_seconds(a + b))
+
+
+def overlap_ratio(
+    stage: List[Tuple[float, float]], compute: List[Tuple[float, float]]
+) -> float:
+    """Fraction of staging wall time spent concurrently with compute/collect
+    work: ``overlap(stage, compute) / union(stage)``. A serial loop (stage,
+    then compute, never both) scores 0; a perfectly hidden stage scores 1.
+    This is the one source of truth behind ``photon_stream_overlap_ratio``
+    and BASELINE.md's streamed-overlap claims."""
+    stage_union = _union_seconds(stage)
+    if stage_union <= 0.0:
+        return 0.0
+    return interval_overlap_seconds(stage, compute) / stage_union
+
+
 class TimelineRecorder(EventListener):
     """Collects closed spans; thread-safe (sinks can run on any thread)."""
 

@@ -91,9 +91,15 @@ def current_span() -> Optional[Span]:
 
 
 @contextlib.contextmanager
-def span(name: str, **attrs):
-    """Open a span named ``name``; nests under the current span if any."""
-    parent = _ctx.get()
+def span(name: str, parent: Optional[Span] = None, **attrs):
+    """Open a span named ``name``; nests under the current span if any.
+
+    ``parent`` overrides the contextvar nesting — spans opened on worker
+    threads (pipeline staging/eval lanes) have no ancestry there, so the
+    lane owner passes the anchor span explicitly to keep the tree rooted
+    under the sweep it serves."""
+    if parent is None:
+        parent = _ctx.get()
     s = Span(
         name=name,
         span_id=f"s{next(_ids)}",

@@ -333,7 +333,10 @@ def _shard_psum_call(mesh, inner, rep_mask, n_out, args):
     (pallas_call has no GSPMD partitioning rule, so collective placement is
     explicit). ``rep_mask[i]`` marks argument i replicated; non-replicated
     args are row-sharded (arg 0 is the 2-D X, the rest are [n] vectors)."""
-    from jax import shard_map
+    try:
+        from jax import shard_map
+    except ImportError:  # pre-0.6 jax ships it under experimental only
+        from jax.experimental.shard_map import shard_map
     from jax.sharding import PartitionSpec as P
 
     from ..parallel.mesh import DATA_AXIS  # lazy: parallel imports ops

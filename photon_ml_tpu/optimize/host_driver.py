@@ -196,8 +196,15 @@ def solve_lbfgs_host(
     l1_weight: float = 0.0,
     box_constraints: Optional[Tuple[np.ndarray, np.ndarray]] = None,
     max_line_search_iterations: int = 25,
+    initial_eval: Optional[Tuple[float, np.ndarray]] = None,
 ) -> SolverResult:
-    """Host port of lbfgs._solve for one lane; numpy-leaved SolverResult."""
+    """Host port of lbfgs._solve for one lane; numpy-leaved SolverResult.
+
+    ``initial_eval``: a pre-dispatched raw ``value_and_grad(w0)`` result
+    (pipelined tolerance overlap, host_optimize); the L1 term is applied
+    here with the same arithmetic as ``full_objective``, so the iterate
+    stream is bit-identical to evaluating in place. Only valid without box
+    constraints (the initial clip would move the evaluation point)."""
     dtype = w0.dtype
     l1 = float(l1_weight)
     box = None
@@ -224,7 +231,14 @@ def solve_lbfgs_host(
     w = np.array(w0, dtype, copy=True)
     if box is not None:
         w = np.clip(w, box[0], box[1])
-    f, g = full_objective(w)
+    if initial_eval is not None and box is None:
+        f, g = initial_eval
+        f = float(f)
+        if l1 > 0.0:
+            f = f + l1 * float(np.sum(np.abs(w)))
+        g = np.asarray(g)
+    else:
+        f, g = full_objective(w)
 
     T = max_iterations + 1
     lh = np.full(T, np.nan, dtype)
@@ -351,8 +365,13 @@ def solve_tron_host(
     max_cg_iterations: int = 20,
     max_improvement_failures: int = 5,
     box_constraints: Optional[Tuple[np.ndarray, np.ndarray]] = None,
+    initial_eval: Optional[Tuple[float, np.ndarray]] = None,
 ) -> SolverResult:
-    """Host port of tron._solve for one lane; numpy-leaved SolverResult."""
+    """Host port of tron._solve for one lane; numpy-leaved SolverResult.
+
+    ``initial_eval``: pre-dispatched ``value_and_grad(w0)`` (pipelined
+    tolerance overlap, host_optimize) — TRON starts from unclipped w0, so
+    the substitution is exact."""
     dtype = w0.dtype
     box = None
     if box_constraints is not None:
@@ -362,7 +381,7 @@ def solve_tron_host(
         )
 
     w = np.array(w0, dtype, copy=True)
-    fg = value_and_grad(w)
+    fg = initial_eval if initial_eval is not None else value_and_grad(w)
     f, g = float(fg[0]), np.asarray(fg[1])
 
     T = max_iterations + 1
@@ -452,13 +471,33 @@ def host_optimize(
     w0: np.ndarray,
     config: OptimizerConfig,
     hvp: Optional[HostHvpFn] = None,
+    value_and_grad_deferred: Optional[Callable] = None,
 ) -> SolverResult:
     """Host twin of driver.optimize: tolerance conversion from the zero
     state, then dispatch on the normalized optimizer type. Records the same
     per-solver obs metrics as the device drivers (solver labels ``lbfgs`` /
-    ``tron``; numpy results are fetch-free to record)."""
+    ``tron``; numpy results are fetch-free to record).
+
+    ``value_and_grad_deferred``: dispatch-only form of ``value_and_grad``
+    (returns a fetch closure — StreamedFEObjective.value_and_grad_deferred).
+    When provided, the tolerance pass at zeros and the first real evaluation
+    at w0 are BOTH dispatched before either is fetched, so the driver's two
+    mandatory serial passes overlap on device. Same kernels on the same
+    operands → same bits; skipped under box constraints, where the solver's
+    initial clip moves the evaluation point."""
     w0 = np.asarray(w0)
-    loss_tol, grad_tol = host_abs_tolerances(value_and_grad, w0, config.tolerance)
+    initial_eval = None
+    if value_and_grad_deferred is not None and config.box_constraints is None:
+        fetch_zero = value_and_grad_deferred(np.zeros_like(w0))
+        fetch_w0 = value_and_grad_deferred(w0)
+        f0, g0 = fetch_zero()
+        loss_tol = abs(float(f0)) * config.tolerance
+        grad_tol = _norm(np.asarray(g0)) * config.tolerance
+        initial_eval = fetch_w0()
+    else:
+        loss_tol, grad_tol = host_abs_tolerances(
+            value_and_grad, w0, config.tolerance
+        )
     kind = config.normalized_type()
 
     if kind in (OptimizerType.LBFGS, OptimizerType.LBFGSB, OptimizerType.OWLQN):
@@ -472,6 +511,7 @@ def host_optimize(
             l1_weight=config.l1_weight if kind == OptimizerType.OWLQN else 0.0,
             box_constraints=config.box_constraints,
             max_line_search_iterations=config.max_line_search_iterations,
+            initial_eval=initial_eval,
         )
         obs.record_solver_metrics("lbfgs", result)
         return result
@@ -488,6 +528,7 @@ def host_optimize(
             max_cg_iterations=config.max_cg_iterations,
             max_improvement_failures=config.max_improvement_failures,
             box_constraints=config.box_constraints,
+            initial_eval=initial_eval,
         )
         obs.record_solver_metrics("tron", result)
         return result

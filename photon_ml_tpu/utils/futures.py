@@ -1,12 +1,17 @@
-"""Daemon-thread futures for background decode pipelines.
+"""Daemon-thread futures and bounded prefetch queues for background pipelines.
 
 Extracted from cli/train's background validation decode so io/data's chunked
 training-data reader can share it (one-part lookahead decode).
+:class:`PrefetchQueue` generalizes the single lookahead into a bounded-depth
+producer lane; the sweep pipelining layer (game/pipeline.py) and the chunked
+ingest reader both build on it.
 """
 
 from __future__ import annotations
 
+import collections
 import threading
+from typing import Callable, Optional, Tuple
 
 
 class DaemonFuture:
@@ -49,3 +54,126 @@ class DaemonFuture:
         if self._error is not None:
             raise self._error
         return self._value
+
+
+class PrefetchQueue:
+    """Bounded-depth generalization of :class:`DaemonFuture`'s one-item
+    lookahead: a single daemon worker produces ``produce(i)`` for
+    ``i in 0..count-1`` (forever, cyclically, when ``cyclic=True``) and parks
+    up to ``depth`` finished items in a FIFO; :meth:`get` pops them in
+    production order.
+
+    ``cost``/``budget`` optionally bound the bytes in flight: the worker
+    stalls while the queued items PLUS the item the consumer currently holds
+    plus the next item would exceed ``budget``. An empty queue always admits
+    one item so the pipeline can make progress — the same 2-resident worst
+    case as the inline double buffer this replaces.
+
+    Same crash contract as DaemonFuture: the worker is a daemon thread, an
+    in-flight ``produce`` runs to completion but is never joined, and a
+    worker error is parked in order and re-raised by the matching
+    :meth:`get`."""
+
+    def __init__(
+        self,
+        produce: Callable[[int], object],
+        count: int,
+        depth: int = 2,
+        *,
+        cyclic: bool = False,
+        cost: Optional[Callable[[int], int]] = None,
+        budget: Optional[int] = None,
+        name: str = "photon-prefetch",
+    ):
+        if depth < 1:
+            raise ValueError(f"prefetch depth must be >= 1: {depth}")
+        if count < 1:
+            raise ValueError(f"prefetch count must be >= 1: {count}")
+        self._produce = produce
+        self._count = int(count)
+        self._depth = int(depth)
+        self._cyclic = bool(cyclic)
+        self._cost = cost
+        self._budget = budget
+        # (index, item, cost, error) in production order
+        self._q: collections.deque = collections.deque()
+        self._held_cost = 0  # the item the consumer holds still occupies HBM
+        self._inflight = 0  # queued + held cost
+        self.peak_inflight = 0
+        self._closed = False
+        self._exhausted = False
+        self._cv = threading.Condition()
+        self._thread = threading.Thread(target=self._work, name=name, daemon=True)
+        self._thread.start()
+
+    def _admissible(self, next_cost: int) -> bool:
+        if len(self._q) >= self._depth:
+            return False
+        if self._budget is None or not self._q:
+            return True
+        return self._inflight + next_cost <= self._budget
+
+    def _work(self) -> None:
+        i = 0
+        while True:
+            if not self._cyclic and i >= self._count:
+                with self._cv:
+                    self._exhausted = True
+                    self._cv.notify_all()
+                return
+            c = int(self._cost(i)) if self._cost is not None else 0
+            with self._cv:
+                while not self._closed and not self._admissible(c):
+                    self._cv.wait()
+                if self._closed:
+                    return
+            try:
+                item, error = self._produce(i), None
+            # photon: ignore[R4] — future semantics: parked, re-raised in get()
+            except BaseException as e:
+                item, error = None, e
+            with self._cv:
+                if self._closed:
+                    return
+                self._q.append((i, item, c, error))
+                self._inflight += c
+                self.peak_inflight = max(self.peak_inflight, self._inflight)
+                self._cv.notify_all()
+                if error is not None:
+                    self._exhausted = True
+                    return
+            i += 1
+            if self._cyclic and i >= self._count:
+                i = 0
+
+    def get(self) -> Tuple[int, object]:
+        """Pop the next item in production order (blocks until staged);
+        implicitly releases the previously returned item's budget share."""
+        with self._cv:
+            while not self._q:
+                if self._closed:
+                    raise RuntimeError("PrefetchQueue is closed")
+                if self._exhausted:
+                    raise RuntimeError("PrefetchQueue is exhausted")
+                self._cv.wait()
+            idx, item, c, error = self._q.popleft()
+            self._inflight -= self._held_cost
+            self._held_cost = c
+            self._cv.notify_all()
+        if error is not None:
+            self.close()
+            raise error
+        return idx, item
+
+    def qsize(self) -> int:
+        with self._cv:
+            return len(self._q)
+
+    def close(self) -> None:
+        """Stop the worker and drop queued items; an in-flight ``produce``
+        runs to completion in the background (never joined)."""
+        with self._cv:
+            self._closed = True
+            self._q.clear()
+            self._inflight = self._held_cost
+            self._cv.notify_all()

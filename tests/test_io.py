@@ -569,3 +569,28 @@ def test_chunked_reader_counts_parts(tmp_path):
     }
     assert snap[("photon_ingest_parts_total", "chunked")]["value"] == 3
     assert snap[("photon_ingest_rows_total", "chunked")]["value"] == ds.n_rows == 60
+    # the bounded prefetch queue reports its occupancy (0..depth; the last
+    # get always observes an empty queue, so the final value is 0)
+    assert snap[("photon_ingest_queue_depth", "chunked")]["value"] == 0
+
+
+def test_chunked_reader_prefetch_depth_validated(tmp_path):
+    import pytest as _pytest
+
+    from photon_ml_tpu.io import read_avro_dataset_chunked
+
+    path = _write_parts(tmp_path, n_parts=3, per_part=20)
+    shards = {"g": FeatureShardConfig(feature_bags=("features",))}
+    with _pytest.raises(ValueError, match="prefetch_depth"):
+        read_avro_dataset_chunked(
+            path, shards, engine="python", prefetch_depth=0
+        )
+    # deeper lookahead lands on the identical dataset (order is pinned)
+    _, maps = read_avro_dataset(path, shards, engine="python")
+    a, _ = read_avro_dataset_chunked(
+        path, shards, index_maps=maps, engine="python", prefetch_depth=3
+    )
+    b, _ = read_avro_dataset_chunked(
+        path, shards, index_maps=maps, engine="python", prefetch_depth=1
+    )
+    _assert_same_dataset(a, b)

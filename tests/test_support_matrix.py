@@ -147,6 +147,12 @@ def _trigger_serving_width_ladder(raw):
     _ladder_width(LADDER_WIDTH[-1] + 1)
 
 
+def _trigger_pipeline_distributed(raw):
+    from photon_ml_tpu.cli.params import check_pipeline_composition
+
+    check_pipeline_composition(2, distributed=True)
+
+
 def _trigger_serving_store_version(raw, tmp_path):
     import json as _json
 
@@ -259,6 +265,12 @@ CASES = [
         "unsupported serving store version",
         ValueError,
         _trigger_serving_store_version,
+    ),
+    (
+        "pipeline-depth-distributed",
+        "pipeline.depth=2 is not supported with --distributed",
+        ValueError,
+        _trigger_pipeline_distributed,
     ),
 ]
 
