@@ -499,6 +499,114 @@ def bench_streamed_fe(
     }
 
 
+def bench_ingest(n=50_000, n_parts=8, budget_mb=64):
+    """Host ingest throughput vs decode-pool size (--ingest-workers): the
+    pure-Python chunked reader over an ``n_parts``-part GLMix file (20 global
+    + 10 per-user features, deflate) at workers {1, 2, 4, auto}, plus the
+    disk->slice streamed fixed-effect build
+    (game/data.build_fixed_effect_dataset_from_disk: disk -> pooled decode ->
+    HostRowBatch row slices, never a concatenated RawDataset).
+
+    Row order and outputs are bit-identical at any worker count (the
+    sequencer re-emits parts in file order), so the series measures pure
+    decode parallelism. value = rows/s at workers=4; vs_baseline =
+    workers-4 / workers-1 scaling (~1.0 on a single-core host — the per-part
+    decode is embarrassingly parallel by construction, so scaling shows up
+    exactly where the cores are)."""
+    import shutil
+    import tempfile
+
+    from photon_ml_tpu.game.data import build_fixed_effect_dataset_from_disk
+    from photon_ml_tpu.io.avro import write_avro_file
+    from photon_ml_tpu.io.data import (
+        FeatureShardConfig,
+        read_avro_dataset_chunked,
+        resolve_ingest_workers,
+    )
+    from photon_ml_tpu.io.schemas import TRAINING_EXAMPLE_AVRO
+    from photon_ml_tpu.testing.generators import (
+        generate_game_records,
+        generate_mixed_effect_data,
+    )
+
+    data = generate_mixed_effect_data(
+        n=n, d_fixed=20, re_specs={"userId": (200, 10)}, seed=0
+    )
+    recs = generate_game_records(data)
+    shards = {
+        "global": FeatureShardConfig(feature_bags=("features",)),
+        "userShard": FeatureShardConfig(feature_bags=("userFeatures",)),
+    }
+    tmp = tempfile.mkdtemp(prefix="photon-bench-ingest-")
+    try:
+        per = (len(recs) + n_parts - 1) // n_parts
+        for k in range(n_parts):
+            write_avro_file(
+                os.path.join(tmp, f"part-{k:05d}.avro"),
+                TRAINING_EXAMPLE_AVRO,
+                recs[k * per : (k + 1) * per],
+                codec="deflate",
+            )
+        mb = sum(
+            os.path.getsize(os.path.join(tmp, f)) for f in os.listdir(tmp)
+        ) / 1e6
+
+        def _read(workers):
+            t0 = time.perf_counter()
+            ds, _ = read_avro_dataset_chunked(
+                tmp, shards, engine="python", workers=workers,
+                ingest_budget_bytes=budget_mb << 20,
+            )
+            wall = time.perf_counter() - t0
+            assert ds.n_rows == n
+            return n / wall
+
+        _read(1)  # warm the page cache off the clock
+        series = {}
+        for label, w in (("1", 1), ("2", 2), ("4", 4), ("auto", None)):
+            series[f"workers_{label}_rows_per_sec"] = round(_read(w), 1)
+
+        t0 = time.perf_counter()
+        ds, _ = build_fixed_effect_dataset_from_disk(
+            tmp, shards, "global", "global", budget_mb << 20, workers=4,
+            ingest_budget_bytes=budget_mb << 20,
+        )
+        wall_slice = time.perf_counter() - t0
+        assert ds.true_n_rows == n and ds.streamed
+        series["disk_slice_rows_per_sec"] = round(n / wall_slice, 1)
+    finally:
+        shutil.rmtree(tmp, ignore_errors=True)
+
+    # direction self-check: every ingest series must diff as higher-is-better
+    # (a rows/s series gating lower-is-better would flag speedups as
+    # regressions)
+    for name in ("ingest_pooled_rows_per_sec", *series):
+        assert not _lower_is_better(name), (
+            f"--diff direction check: ingest series {name!r} must be "
+            "higher-is-better"
+        )
+
+    r1 = series["workers_1_rows_per_sec"]
+    r4 = series["workers_4_rows_per_sec"]
+    n_auto = resolve_ingest_workers(None)
+    return {
+        "metric": "ingest_pooled_rows_per_sec",
+        "value": r4,
+        "unit": (
+            f"rows/sec, pure-Python chunked decode of a {n}-row {n_parts}-part "
+            f"GLMix file ({mb:.1f} MB deflate, 20 global + 10 per-user "
+            f"features) at --ingest-workers 4; workers 1/2/4/auto(={n_auto}) = "
+            f"{r1:.0f}/{series['workers_2_rows_per_sec']:.0f}/{r4:.0f}/"
+            f"{series['workers_auto_rows_per_sec']:.0f}; disk->slice streamed "
+            f"FE build {series['disk_slice_rows_per_sec']:.0f} rows/s "
+            f"(cpu_count={os.cpu_count()}); bit-identical output at any "
+            "worker count"
+        ),
+        "vs_baseline": round(r4 / r1, 2),
+        "quadrants": {"ingest": series},
+    }
+
+
 def _bench_multichip_child(n_devices: int) -> dict:
     """One mesh size of the multichip bench, meant to run in a fresh process
     (the CPU backend's virtual device count is fixed at first backend init).
@@ -1142,12 +1250,12 @@ def _lower_is_better(name: str) -> bool:
 
 def _diff_one(name: str, old_v: float, new_v: float, tolerance: float) -> dict:
     lower_better = _lower_is_better(name)
-    # direction self-check: an overlap series that ever classifies as
-    # lower-is-better would flag pipelining IMPROVEMENTS as regressions —
-    # fail the diff loudly instead of inverting the gate
-    if "overlap" in name.lower() and lower_better:
+    # direction self-check: an overlap or rows/s series that ever classifies
+    # as lower-is-better would flag pipelining/ingest IMPROVEMENTS as
+    # regressions — fail the diff loudly instead of inverting the gate
+    if ("overlap" in name.lower() or "rows_per_sec" in name.lower()) and lower_better:
         raise AssertionError(
-            f"--diff direction check: overlap series {name!r} must be "
+            f"--diff direction check: series {name!r} must be "
             "higher-is-better"
         )
     if old_v == 0:
@@ -1238,7 +1346,7 @@ def main(argv: Optional[List[str]] = None):
         "--config",
         choices=[
             "glmix", "sparse", "billion", "tiled", "hbm", "streamed-fe",
-            "serving", "multichip",
+            "serving", "multichip", "ingest",
         ],
         default="glmix",
     )
@@ -1359,6 +1467,9 @@ def main(argv: Optional[List[str]] = None):
         return
     if a.config == "serving":
         print(json.dumps(bench_serving()))
+        return
+    if a.config == "ingest":
+        print(json.dumps(bench_ingest()))
         return
 
     n = a.n

@@ -157,6 +157,33 @@ def add_common_io_args(p: argparse.ArgumentParser):
         default=None,
         help="directory of prebuilt index stores (FeatureIndexingDriver output)",
     )
+    p.add_argument(
+        "--ingest-workers",
+        type=parse_ingest_workers,
+        default=None,
+        help="decode-pool size for training ingest AND the background "
+        "validation decode (the executor-fleet decode of AvroDataReader): "
+        "'auto' (default) = cpu_count - 2, min 1; an explicit N >= 1 pins "
+        "the pool. Output is bit-identical at any worker count.",
+    )
+
+
+def parse_ingest_workers(value):
+    """--ingest-workers: 'auto'/'' -> None (host-sized later, cpu_count - 2
+    min 1, by io/data.resolve_ingest_workers); otherwise an int >= 1."""
+    if value is None or value == "" or str(value).lower() == "auto":
+        return None
+    try:
+        w = int(value)
+    except ValueError:
+        raise argparse.ArgumentTypeError(
+            f"--ingest-workers expects an integer >= 1 or 'auto', got {value!r}"
+        )
+    if w < 1:
+        raise argparse.ArgumentTypeError(
+            f"--ingest-workers must be >= 1: {w}"
+        )
+    return w
 
 
 def resolve_input_paths(args):

@@ -30,7 +30,12 @@ import numpy as np
 
 from .. import obs
 from ..estimators.game_estimator import GameEstimator, GameResult, GameTransformer
-from ..io import read_avro_dataset, read_avro_dataset_chunked, save_game_model
+from ..io import (
+    read_avro_dataset,
+    read_avro_dataset_chunked,
+    resolve_ingest_workers,
+    save_game_model,
+)
 from ..io.index_map import IndexMap
 from ..io.model_io import load_game_model
 from ..parallel import multihost
@@ -38,7 +43,7 @@ from ..robust import CheckpointManager, atomic_write, atomic_write_json, faults
 from ..ops.normalization import build_normalization
 from ..tuning.rescaling import HyperparameterConfig, ParamRange
 from ..tuning.tuner import get_tuner
-from ..utils.futures import DaemonFuture
+from ..utils.futures import DaemonFuture, WorkerPool
 from ..utils.logging import setup_logging
 from ..utils.stats import compute_feature_statistics, save_feature_statistics
 from .params import (
@@ -402,19 +407,34 @@ def _run_training(args, run_t, metric_sinks, t_run0, recorder=None) -> Dict:
             multihost.process_index(), row_range[0], row_range[1], total_rows,
             equal_share,
         )
+    ingest_pool = None
     if multihost.process_count() == 1:
-        # pipelined ingest (io/data.read_avro_dataset_chunked): part k+1
-        # decodes on a daemon thread while part k converts to columnar
-        # arrays and is freed — decode overlaps dataset build instead of
-        # blocking up front, and peak record RSS is ~2 parts, not the input
-        raw, index_maps = read_avro_dataset_chunked(
-            input_paths,
-            shards,
-            index_maps=index_maps,
-            id_tag_columns=id_tags,
-            response_column=args.response_column,
-            columns=input_columns,
-        )
+        # pipelined pooled ingest (io/data.read_avro_dataset_chunked):
+        # --ingest-workers parts decode concurrently on the shared pool
+        # (sequenced back to file order, bit-identical at any count) while
+        # the consumer converts each part to columnar arrays and frees it —
+        # decode overlaps dataset build, peak record RSS stays bounded by
+        # the queue depth, and the SAME pool later runs the background
+        # validation decode instead of oversubscribing cores with a second
+        # thread fleet
+        n_ingest_workers = resolve_ingest_workers(args.ingest_workers)
+        ingest_pool = WorkerPool(n_ingest_workers, name="photon-ingest")
+        try:
+            raw, index_maps = read_avro_dataset_chunked(
+                input_paths,
+                shards,
+                index_maps=index_maps,
+                id_tag_columns=id_tags,
+                response_column=args.response_column,
+                columns=input_columns,
+                workers=n_ingest_workers,
+                pool=ingest_pool,
+            )
+        except BaseException:
+            # a failed read leaves no future behind — release the workers
+            # instead of leaking idle daemon threads across in-process runs
+            ingest_pool.close()
+            raise
     else:
         # multi-process: row-windowed read on the main thread (collective
         # ordering across hosts must stay deterministic)
@@ -428,50 +448,60 @@ def _run_training(args, run_t, metric_sinks, t_run0, recorder=None) -> Dict:
             row_range=row_range,
             part_counts=part_counts,
         )
-    if row_range is not None:
-        raw.global_row_start = row_range[0]
-    if args.validate_data != "disabled":
-        # validate BEFORE multi-process padding: pad rows are synthetic
-        # zero-weight rows that would dilute the sample and trip nothing
-        from ..io import validators
+    try:
+        if row_range is not None:
+            raw.global_row_start = row_range[0]
+        if args.validate_data != "disabled":
+            # validate BEFORE multi-process padding: pad rows are synthetic
+            # zero-weight rows that would dilute the sample and trip nothing
+            from ..io import validators
 
-        mode = {
-            "full": validators.VALIDATE_FULL,
-            "sample": validators.VALIDATE_SAMPLE,
-            "quarantine": validators.VALIDATE_QUARANTINE,
-        }[args.validate_data]
-        validators.validate_dataset(raw, args.task, mode, rng_seed=args.seed)
-    if equal_share is not None:
-        raw = raw.pad_rows(equal_share)
-    logger.info("training rows: %d; shard dims: %s", raw.n_rows, raw.shard_dims)
+            mode = {
+                "full": validators.VALIDATE_FULL,
+                "sample": validators.VALIDATE_SAMPLE,
+                "quarantine": validators.VALIDATE_QUARANTINE,
+            }[args.validate_data]
+            validators.validate_dataset(raw, args.task, mode, rng_seed=args.seed)
+        if equal_share is not None:
+            raw = raw.pad_rows(equal_share)
+        logger.info(
+            "training rows: %d; shard dims: %s", raw.n_rows, raw.shard_dims
+        )
 
-    validation = None
-    if args.validation_data:
-        def _read_validation():
-            v, _ = read_avro_dataset(
-                args.validation_data,
-                shards,
-                index_maps=index_maps,
-                id_tag_columns=id_tags,
-                response_column=args.response_column,
-                columns=input_columns,
-            )
-            return v
+        validation = None
+        if args.validation_data:
+            def _read_validation():
+                v, _ = read_avro_dataset(
+                    args.validation_data,
+                    shards,
+                    index_maps=index_maps,
+                    id_tag_columns=id_tags,
+                    response_column=args.response_column,
+                    columns=input_columns,
+                )
+                return v
 
-        if multihost.process_count() == 1:
-            # ingest overlap: decode validation on a background DAEMON thread
-            # (the native Avro decoder releases the GIL) while the training
-            # datasets build and upload; the estimator resolves the future
-            # only when the validation context is first needed
-            # (executor-parallel decode, AvroDataReader.scala:165-209).
-            # Daemon (vs ThreadPoolExecutor): a crash elsewhere exits bounded
-            # instead of blocking on concurrent.futures' atexit join of a
-            # decode that nobody will consume
-            validation = _DaemonFuture(_read_validation)
-        else:
-            # multi-process: keep the read on the main thread (collective
-            # ordering across hosts must stay deterministic)
-            validation = _read_validation()
+            if multihost.process_count() == 1:
+                # ingest overlap: decode validation on the SAME worker pool
+                # the training ingest used (the native Avro decoder releases
+                # the GIL) while the training datasets build and upload; the
+                # estimator resolves the future only when the validation
+                # context is first needed (executor-parallel decode,
+                # AvroDataReader.scala:165-209). Pool workers are daemon
+                # threads (vs ThreadPoolExecutor): a crash elsewhere exits
+                # bounded instead of blocking on concurrent.futures' atexit
+                # join of a decode that nobody will consume
+                validation = ingest_pool.submit(_read_validation)
+            else:
+                # multi-process: keep the read on the main thread (collective
+                # ordering across hosts must stay deterministic)
+                validation = _read_validation()
+    finally:
+        if ingest_pool is not None:
+            # stop accepting work; the already-queued validation decode
+            # still drains. Repeated in-process train_run calls then never
+            # accumulate idle worker threads
+            ingest_pool.close()
 
     # normalization from feature statistics (GameTrainingDriver:555-571)
     if args.normalization != "NONE":

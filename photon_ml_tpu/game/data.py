@@ -345,6 +345,180 @@ def build_fixed_effect_dataset(
     )
 
 
+def build_fixed_effect_dataset_from_disk(
+    path,
+    shard_configs,
+    coordinate_id: str,
+    feature_shard: str,
+    hbm_budget_bytes: int,
+    *,
+    index_maps=None,
+    id_tag_columns=(),
+    response_column: str = "label",
+    columns=None,
+    reader_schema=None,
+    dtype=jnp.float32,
+    layout: str = "auto",
+    feature_dtype=None,
+    workers=None,
+    pool=None,
+    ingest_budget_bytes: Optional[int] = None,
+    prefetch_depth: int = 2,
+):
+    """Disk → :class:`HostRowBatch` without ever materializing the full
+    ``RawDataset``: part files decode across the ingest worker pool
+    (``io/data.read_avro_part_pieces``) and each part's rows are written
+    straight into the preallocated host feature planes, so rows go
+    disk → decode → stage → chip with peak record residency of one part
+    plus the decode pipeline. Returns ``(dataset, index_maps)`` with the
+    dataset ALWAYS in streamed form (``game/fe_streaming.py`` row slices
+    under ``hbm_budget_bytes``) — this path exists to feed the streamed
+    fixed effect; use ``read_avro_dataset_chunked`` +
+    :func:`build_fixed_effect_dataset` when a resident batch is wanted.
+
+    Bitwise parity with the in-memory builder: parts arrive in file order
+    with contiguous ascending row blocks, so the per-part
+    ``np.add.at`` / ``_rows_to_ell(width=global)`` fills produce arrays
+    identical to the global constructions on the concatenated COO, and
+    scalar planes are filled elementwise (``astype`` commutes with
+    concatenation). The dense layout truly streams (one part's COO alive
+    at a time); the ELL layout buffers each part's compact COO arrays
+    until the global row-nnz width is known — O(nnz) host memory, still
+    never the record dicts or a concatenated ``RawDataset``.
+
+    ``workers``/``pool``/``ingest_budget_bytes``/``prefetch_depth`` pass
+    through to the decode pool; the pool's RSS backpressure
+    (``ingest_budget_bytes``, compressed bytes in flight) composes with the
+    ``hbm_budget_bytes`` slice accounting the streamed objective applies
+    on the device side."""
+    from .. import obs
+    from ..io.avro import count_avro_rows, list_avro_parts
+    from ..io.data import read_avro_part_pieces, scan_index_maps_pipelined
+
+    paths = [path] if isinstance(path, str) else list(path)
+    parts = [part for p in paths for part in list_avro_parts(p)]
+    if not parts:
+        raise ValueError(f"no .avro part files under {paths!r}")
+
+    with obs.span("ingest.disk_slice", n_parts=len(parts)):
+        if index_maps is None:
+            index_maps = scan_index_maps_pipelined(
+                parts, shard_configs, reader_schema,
+                prefetch_depth=prefetch_depth, workers=workers, pool=pool,
+                ingest_budget_bytes=ingest_budget_bytes,
+            )
+        d = len(index_maps[feature_shard])
+        eff_layout = layout
+        if eff_layout == "auto":
+            # same rule as RawDataset.to_batch's auto resolution
+            eff_layout = "dense" if d <= 4096 else "ell"
+        if eff_layout not in ("dense", "ell"):
+            raise ValueError(
+                f"coordinate {coordinate_id}: the disk-to-slice ingest path "
+                f"requires a row-sliceable layout (auto|dense|ell), got "
+                f"layout={layout!r}"
+            )
+        # header-only row counts: block counts, no decompression
+        n = sum(count_avro_rows(part) for part in parts)
+        fdt = np.dtype(jnp.zeros((), feature_dtype or dtype).dtype)
+        sdt = np.dtype(jnp.zeros((), dtype).dtype)
+
+        labels = np.empty(n, sdt)
+        offsets = np.empty(n, sdt)
+        weights = np.empty(n, sdt)
+        row0 = 0
+        if eff_layout == "dense":
+            # f64 accumulator, cast once at the end — identical to the
+            # in-memory streamed branch's global np.add.at + astype
+            dense = np.zeros((n, d), np.float64)
+
+            def _drain(_i, piece) -> None:
+                nonlocal row0
+                np_rows = piece.n_rows
+                labels[row0:row0 + np_rows] = piece.labels.astype(sdt)
+                offsets[row0:row0 + np_rows] = piece.offsets.astype(sdt)
+                weights[row0:row0 + np_rows] = piece.weights.astype(sdt)
+                rows, cols, vals = piece.shard_coo[feature_shard]
+                np.add.at(dense[row0:row0 + np_rows], (rows, cols), vals)
+                row0 += np_rows
+
+            read_avro_part_pieces(
+                paths, shard_configs, _drain, index_maps,
+                id_tag_columns=id_tag_columns,
+                response_column=response_column, columns=columns,
+                reader_schema=reader_schema, prefetch_depth=prefetch_depth,
+                workers=workers, pool=pool,
+                ingest_budget_bytes=ingest_budget_bytes,
+            )
+            host = HostRowBatch(
+                dim=d, labels=labels, offsets=offsets, weights=weights,
+                dense=dense.astype(fdt),
+            )
+        else:
+            # ELL needs the GLOBAL max row nnz before allocation: buffer
+            # each part's compact COO (O(nnz)), then fill per part with the
+            # shared width — bit-identical to the global _rows_to_ell
+            # because row blocks are contiguous and ascending
+            coo_parts = []
+
+            def _buffer(_i, piece) -> None:
+                nonlocal row0
+                np_rows = piece.n_rows
+                labels[row0:row0 + np_rows] = piece.labels.astype(sdt)
+                offsets[row0:row0 + np_rows] = piece.offsets.astype(sdt)
+                weights[row0:row0 + np_rows] = piece.weights.astype(sdt)
+                coo_parts.append((np_rows, piece.shard_coo[feature_shard]))
+                row0 += np_rows
+
+            read_avro_part_pieces(
+                paths, shard_configs, _buffer, index_maps,
+                id_tag_columns=id_tag_columns,
+                response_column=response_column, columns=columns,
+                reader_schema=reader_schema, prefetch_depth=prefetch_depth,
+                workers=workers, pool=pool,
+                ingest_budget_bytes=ingest_budget_bytes,
+            )
+            width = 1
+            for np_rows, (rows, _c, _v) in coo_parts:
+                counts = np.bincount(rows, minlength=np_rows)
+                if np_rows:
+                    width = max(width, int(counts.max()))
+            ell_idx = np.zeros((n, width), np.int32)
+            ell_val = np.zeros((n, width), np.float64)
+            r0 = 0
+            for np_rows, (rows, cols, vals) in coo_parts:
+                idx_p, val_p = _rows_to_ell(rows, cols, vals, np_rows, width=width)
+                ell_idx[r0:r0 + np_rows] = idx_p
+                ell_val[r0:r0 + np_rows] = val_p
+                r0 += np_rows
+            del coo_parts
+            host = HostRowBatch(
+                dim=d, labels=labels, offsets=offsets, weights=weights,
+                ell_idx=ell_idx, ell_val=ell_val.astype(fdt),
+            )
+
+        reg = obs.current_run().registry
+        reg.counter(
+            "photon_ingest_parts_total",
+            "part files decoded by the chunked reader",
+        ).labels(mode="disk_slice").inc(len(parts))
+        reg.counter(
+            "photon_ingest_rows_total", "rows produced by the chunked reader"
+        ).labels(mode="disk_slice").inc(n)
+
+    dataset = FixedEffectDataset(
+        coordinate_id=coordinate_id,
+        feature_shard=feature_shard,
+        batch=None,
+        true_dim=d,
+        true_n_rows=n,
+        host_batch=host,
+        streamed=True,
+        hbm_budget_bytes=hbm_budget_bytes,
+    )
+    return dataset, dict(index_maps)
+
+
 def _pearson_keep_mask(
     feats: np.ndarray,  # f8[E, K, S] zero-padded per-entity features
     labels: np.ndarray,  # f8[E, K]

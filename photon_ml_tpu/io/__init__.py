@@ -17,6 +17,9 @@ _EXPORTS = {
     "RawDataset": "data",
     "read_avro_dataset": "data",
     "read_avro_dataset_chunked": "data",
+    "read_avro_part_pieces": "data",
+    "scan_index_maps_pipelined": "data",
+    "resolve_ingest_workers": "data",
     "read_libsvm": "data",
     "records_to_dataset": "data",
     "build_index_maps": "data",

@@ -21,6 +21,7 @@ from __future__ import annotations
 
 import dataclasses
 import os
+import threading
 from typing import Dict, Iterable, List, Mapping, Optional, Sequence, Tuple, Union
 
 import numpy as np
@@ -414,6 +415,179 @@ def _concat_raw(pieces: Sequence[RawDataset]) -> RawDataset:
     )
 
 
+def resolve_ingest_workers(workers: Optional[Union[int, str]] = None) -> int:
+    """Effective decode-pool size: ``None``/``0``/``"auto"`` sizes to the
+    host (``cpu_count - 2``, min 1 — leave the consumer thread and the JAX
+    dispatch thread a core each); explicit counts pass through, min 1."""
+    if workers in (None, 0, "auto"):
+        return max(1, (os.cpu_count() or 1) - 2)
+    w = int(workers)
+    if w < 1:
+        raise ValueError(f"ingest workers must be >= 1: {workers!r}")
+    return w
+
+
+def _pipeline_parts(
+    parts: Sequence[str],
+    reader_schema,
+    consume,
+    *,
+    prefetch_depth: int = 2,
+    workers: Optional[Union[int, str]] = None,
+    pool=None,
+    ingest_budget_bytes: Optional[int] = None,
+) -> None:
+    """Decode ``parts`` across the ingest worker pool and hand each part's
+    record list to ``consume(part_index, records)`` in file order.
+
+    The shared engine under :func:`read_avro_dataset_chunked` and
+    :func:`read_avro_part_pieces`: an N-worker
+    :class:`~photon_ml_tpu.utils.futures.PrefetchQueue` decodes parts
+    concurrently, the sequencer re-emits them in file order (bit-stable row
+    order at any worker count), and ``ingest_budget_bytes`` bounds the parts
+    in flight (queued + held + being-decoded) by compressed on-disk size.
+    Emits ``photon_ingest_decode_seconds{worker=}``,
+    ``photon_ingest_queue_depth`` and
+    ``photon_ingest_budget_stalls_total``."""
+    from ..utils.futures import PrefetchQueue
+    from .. import obs
+
+    n_workers = resolve_ingest_workers(workers)
+    reg = obs.current_run().registry
+    depth_gauge = reg.gauge(
+        "photon_ingest_queue_depth",
+        "decoded parts waiting in the chunked reader's prefetch queue",
+    )
+    decode_hist = reg.histogram(
+        "photon_ingest_decode_seconds",
+        "per-part decode wall inside the ingest worker pool",
+    )
+    stall_counter = reg.counter(
+        "photon_ingest_budget_stalls_total",
+        "part decodes deferred because in-flight bytes hit the ingest budget",
+    )
+    # workers run off the consumer thread: anchor their spans explicitly
+    # (contextvar span ancestry does not cross threads)
+    anchor = obs.current_span()
+
+    def _decode(i: int):
+        part = parts[i]
+        with obs.span(
+            "ingest.decode", parent=anchor, part=os.path.basename(part)
+        ) as sp:
+            records = read_avro_file(part, reader_schema)[1]
+        decode_hist.labels(worker=threading.current_thread().name).observe(
+            sp.duration_s
+        )
+        return records
+
+    # depth >= workers so every worker can hold one part in flight;
+    # at workers=1 this is exactly the pre-pool depth (max(2, 1) == 2)
+    depth = max(prefetch_depth, n_workers)
+    part_cost = (
+        (lambda i: os.path.getsize(parts[i]))
+        if ingest_budget_bytes is not None
+        else None
+    )
+    q = PrefetchQueue(
+        _decode, len(parts), depth=depth,
+        cost=part_cost, budget=ingest_budget_bytes,
+        name="photon-bg-decode", workers=n_workers, pool=pool,
+    )
+    try:
+        for i in range(len(parts)):
+            idx, records = q.get()
+            if idx != i:
+                raise RuntimeError("chunked reader prefetch out of order")
+            depth_gauge.labels(mode="chunked").set(q.qsize())
+            consume(i, records)
+            del records
+    finally:
+        stall_counter.labels(mode="chunked").inc(q.budget_stalls)
+        q.close()
+
+
+def scan_index_maps_pipelined(
+    parts: Sequence[str],
+    shard_configs: Mapping[str, FeatureShardConfig],
+    reader_schema=None,
+    *,
+    prefetch_depth: int = 2,
+    workers: Optional[Union[int, str]] = None,
+    pool=None,
+    ingest_budget_bytes: Optional[int] = None,
+) -> Dict[str, IndexMap]:
+    """Keys-only pooled pass over ``parts``: build the identical index maps
+    the monolithic reader would, at bounded record residency."""
+    keys: Dict[str, set] = {s: set() for s in shard_configs}
+
+    def _scan(_i, records) -> None:
+        for rec in records:
+            for shard, cfg in shard_configs.items():
+                bucket = keys[shard]
+                for bag in cfg.feature_bags:
+                    for key, _ in _collect_bag(rec, bag):
+                        bucket.add(key)
+
+    _pipeline_parts(
+        parts, reader_schema, _scan, prefetch_depth=prefetch_depth,
+        workers=workers, pool=pool, ingest_budget_bytes=ingest_budget_bytes,
+    )
+    return {
+        s: IndexMap.from_keys(
+            keys[s], add_intercept=shard_configs[s].has_intercept
+        )
+        for s in shard_configs
+    }
+
+
+def read_avro_part_pieces(
+    path: Union[str, Sequence[str]],
+    shard_configs: Mapping[str, FeatureShardConfig],
+    consume,
+    index_maps: Mapping[str, IndexMap],
+    id_tag_columns: Sequence[str] = (),
+    response_column: str = "label",
+    columns: Optional[InputColumnsNames] = None,
+    reader_schema=None,
+    prefetch_depth: int = 2,
+    workers: Optional[Union[int, str]] = None,
+    pool=None,
+    ingest_budget_bytes: Optional[int] = None,
+) -> int:
+    """Pooled decode of every part file, converted per part to a
+    :class:`RawDataset` piece and handed to ``consume(part_index, piece)``
+    in file order; pieces are NEVER concatenated, so peak residency is one
+    piece plus the decode pipeline. The building block of the disk→slice
+    streamed fixed-effect path (``game/data.build_fixed_effect_dataset_from_disk``).
+    Requires prebuilt ``index_maps`` (build them with
+    :func:`scan_index_maps_pipelined` or ``cli.index``). Returns the part
+    count."""
+    from .avro import list_avro_parts, parse_schema
+
+    paths = [path] if isinstance(path, str) else list(path)
+    if reader_schema is not None and not isinstance(reader_schema, tuple):
+        reader_schema = parse_schema(reader_schema)
+    parts = [part for p in paths for part in list_avro_parts(p)]
+    if not parts:
+        raise ValueError(f"no .avro part files under {paths!r}")
+
+    def _convert(i: int, records) -> None:
+        consume(
+            i,
+            records_to_dataset(
+                records, shard_configs, index_maps, id_tag_columns,
+                response_column, columns=columns,
+            ),
+        )
+
+    _pipeline_parts(
+        parts, reader_schema, _convert, prefetch_depth=prefetch_depth,
+        workers=workers, pool=pool, ingest_budget_bytes=ingest_budget_bytes,
+    )
+    return len(parts)
+
+
 def read_avro_dataset_chunked(
     path: Union[str, Sequence[str]],
     shard_configs: Mapping[str, FeatureShardConfig],
@@ -424,18 +598,35 @@ def read_avro_dataset_chunked(
     reader_schema=None,
     engine: str = "auto",
     prefetch_depth: int = 2,
+    workers: Optional[Union[int, str]] = None,
+    pool=None,
+    ingest_budget_bytes: Optional[int] = None,
 ) -> Tuple[RawDataset, Dict[str, IndexMap]]:
-    """``read_avro_dataset`` with bounded host RSS and pipelined decode.
+    """``read_avro_dataset`` with bounded host RSS and pooled pipelined decode.
 
     The monolithic Python path decodes EVERY part file into one record list
     before any columnar conversion — peak host memory is the whole input as
     Python dicts. This reader is the training-data twin of cli/train's
     background validation decode: it walks part files through a bounded
-    prefetch queue (``prefetch_depth`` parts decoding ahead on a daemon
-    thread, default 2) while the consumer converts the current part to
-    columnar arrays, then frees the records. Peak record residency is
-    ~``prefetch_depth + 1`` parts instead of all of them, and decode wall
-    overlaps conversion instead of blocking up front.
+    prefetch queue (``prefetch_depth`` parts decoding ahead, default 2)
+    while the consumer converts the current part to columnar arrays, then
+    frees the records. Peak record residency is ~``prefetch_depth + 1``
+    parts instead of all of them, and decode wall overlaps conversion
+    instead of blocking up front.
+
+    ``workers`` fans the per-part decode across a
+    :class:`~photon_ml_tpu.utils.futures.WorkerPool` (``"auto"``/``None``/0
+    sizes to ``cpu_count - 2``, min 1); a sequencer re-emits parts in file
+    order, so output is identical at ANY worker count, and ``workers=1`` is
+    bit-identical to the original single-daemon-thread reader (same decode
+    order, same queue depth). Pass ``pool`` to share one pool across
+    readers (cli/train shares it with the validation decode). The queue
+    depth grows to ``max(prefetch_depth, workers)`` so every worker can hold
+    a part in flight. ``ingest_budget_bytes`` bounds the decoded parts in
+    flight (queued + held + being-decoded) by each part's compressed
+    on-disk size — a deliberately conservative RSS proxy (decoded records
+    are larger); stalls are counted in
+    ``photon_ingest_budget_stalls_total``.
 
     When index maps are not supplied, a keys-only first pass (same bounded
     residency) builds the identical maps the monolithic reader would, at the
@@ -460,11 +651,11 @@ def read_avro_dataset_chunked(
                 engine=engine,
             )
 
-    from ..utils.futures import PrefetchQueue
     from .avro import list_avro_parts, parse_schema
 
     if prefetch_depth < 1:
         raise ValueError(f"prefetch_depth must be >= 1: {prefetch_depth}")
+    resolve_ingest_workers(workers)  # validate before any decode starts
     if reader_schema is not None and not isinstance(reader_schema, tuple):
         reader_schema = parse_schema(reader_schema)
     parts = [part for p in paths for part in list_avro_parts(p)]
@@ -476,57 +667,19 @@ def read_avro_dataset_chunked(
             columns=columns, reader_schema=reader_schema, engine="python",
         )
 
-    def _decode(part: str):
-        return read_avro_file(part, reader_schema)[1]
-
     from .. import obs
-
-    depth_gauge = obs.current_run().registry.gauge(
-        "photon_ingest_queue_depth",
-        "decoded parts waiting in the chunked reader's prefetch queue",
-    )
-
-    def _pipelined(consume) -> None:
-        """Decode up to ``prefetch_depth`` parts ahead while `consume`
-        digests the current one (order preserved — row order is bit-stable)."""
-        q = PrefetchQueue(
-            lambda i: _decode(parts[i]), len(parts), depth=prefetch_depth,
-            name="photon-bg-decode",
-        )
-        try:
-            for i in range(len(parts)):
-                idx, records = q.get()
-                if idx != i:
-                    raise RuntimeError("chunked reader prefetch out of order")
-                depth_gauge.labels(mode="chunked").set(q.qsize())
-                consume(records)
-                del records
-        finally:
-            q.close()
 
     with obs.span("ingest.chunked", n_parts=len(parts)):
         if index_maps is None:
-            keys: Dict[str, set] = {s: set() for s in shard_configs}
-
-            def _scan(records) -> None:
-                for rec in records:
-                    for shard, cfg in shard_configs.items():
-                        bucket = keys[shard]
-                        for bag in cfg.feature_bags:
-                            for key, _ in _collect_bag(rec, bag):
-                                bucket.add(key)
-
-            _pipelined(_scan)
-            index_maps = {
-                s: IndexMap.from_keys(
-                    keys[s], add_intercept=shard_configs[s].has_intercept
-                )
-                for s in shard_configs
-            }
+            index_maps = scan_index_maps_pipelined(
+                parts, shard_configs, reader_schema,
+                prefetch_depth=prefetch_depth, workers=workers, pool=pool,
+                ingest_budget_bytes=ingest_budget_bytes,
+            )
 
         pieces: List[RawDataset] = []
 
-        def _convert(records) -> None:
+        def _convert(_i: int, records) -> None:
             pieces.append(
                 records_to_dataset(
                     records, shard_configs, index_maps, id_tag_columns,
@@ -534,7 +687,10 @@ def read_avro_dataset_chunked(
                 )
             )
 
-        _pipelined(_convert)
+        _pipeline_parts(
+            parts, reader_schema, _convert, prefetch_depth=prefetch_depth,
+            workers=workers, pool=pool, ingest_budget_bytes=ingest_budget_bytes,
+        )
 
     ds = _concat_raw(pieces)
     reg = obs.current_run().registry

@@ -479,3 +479,77 @@ def test_score_streamed_fe_direct(raw):
     ref = np.asarray(mem.batch.features.matvec(w))
     out = np.asarray(score_streamed_fe(hb, w, 16 << 10, jnp.float64))
     np.testing.assert_allclose(out, ref, atol=1e-12)
+
+
+def test_disk_to_slice_streamed_fit_matches_memory_path(tmp_path):
+    """Acceptance: a streamed-FE fit fed by the disk→slice path (part files
+    decoded across the ingest worker pool straight into preallocated
+    HostRowBatch planes — no RawDataset ever materializes) is BIT-identical
+    to one fed by the in-memory builder, host planes and trained
+    coefficients alike, at any worker count."""
+    from photon_ml_tpu.game.data import build_fixed_effect_dataset_from_disk
+    from photon_ml_tpu.io import (
+        FeatureShardConfig,
+        read_avro_dataset_chunked,
+        write_avro_file,
+    )
+    from photon_ml_tpu.io.schemas import TRAINING_EXAMPLE_AVRO
+    from photon_ml_tpu.testing.generators import generate_game_records
+
+    data = generate_mixed_effect_data(n=240, d_fixed=6, re_specs={}, seed=7)
+    recs = generate_game_records(data)
+    train_dir = tmp_path / "train"
+    train_dir.mkdir()
+    for i in range(4):
+        write_avro_file(
+            str(train_dir / f"part-{i:05d}.avro"),
+            TRAINING_EXAMPLE_AVRO,
+            recs[i * 60 : (i + 1) * 60],
+        )
+    shards = {"global": FeatureShardConfig(feature_bags=("features",))}
+    raw, maps = read_avro_dataset_chunked(str(train_dir), shards, engine="python")
+    budget = 4 << 10  # far below the matrix footprint => streamed row slices
+    mem = build_fixed_effect_dataset(
+        raw, "global", "global", dtype=jnp.float64, hbm_budget_bytes=budget
+    )
+    assert mem.streamed
+    disk, _ = build_fixed_effect_dataset_from_disk(
+        str(train_dir), shards, "global", "global", budget,
+        index_maps=maps, dtype=jnp.float64, workers=3,
+    )
+    assert disk.streamed
+    assert mem.host_batch.dense.tobytes() == disk.host_batch.dense.tobytes()
+    np.testing.assert_array_equal(mem.host_batch.labels, disk.host_batch.labels)
+
+    cfg = _cfg()
+    m_mem, _ = FixedEffectCoordinate(
+        dataset=mem, task="logistic_regression", config=cfg
+    ).train(None)
+    m_disk, _ = FixedEffectCoordinate(
+        dataset=disk, task="logistic_regression", config=cfg
+    ).train(None)
+    assert (
+        np.asarray(m_mem.model.coefficients.means).tobytes()
+        == np.asarray(m_disk.model.coefficients.means).tobytes()
+    )
+
+
+def test_disk_to_slice_refuses_non_row_sliceable_layout(tmp_path):
+    from photon_ml_tpu.game.data import build_fixed_effect_dataset_from_disk
+    from photon_ml_tpu.io import FeatureShardConfig, write_avro_file
+    from photon_ml_tpu.io.schemas import TRAINING_EXAMPLE_AVRO
+    from photon_ml_tpu.testing.generators import generate_game_records
+
+    data = generate_mixed_effect_data(n=40, d_fixed=4, re_specs={}, seed=9)
+    train_dir = tmp_path / "train"
+    train_dir.mkdir()
+    write_avro_file(
+        str(train_dir / "part-00000.avro"),
+        TRAINING_EXAMPLE_AVRO,
+        generate_game_records(data),
+    )
+    shards = {"global": FeatureShardConfig(feature_bags=("features",))}
+    with pytest.raises(ValueError, match="requires a row-sliceable layout"):
+        build_fixed_effect_dataset_from_disk(
+            str(train_dir), shards, "global", "global", 1 << 20, layout="coo"
+        )

@@ -153,6 +153,28 @@ def _trigger_pipeline_distributed(raw):
     check_pipeline_composition(2, distributed=True)
 
 
+def _trigger_disk_slice_bad_layout(raw, tmp_path):
+    from photon_ml_tpu.game.data import build_fixed_effect_dataset_from_disk
+    from photon_ml_tpu.io import FeatureShardConfig, write_avro_file
+    from photon_ml_tpu.io.schemas import TRAINING_EXAMPLE_AVRO
+    from photon_ml_tpu.testing.generators import generate_game_records
+
+    data = generate_mixed_effect_data(n=8, d_fixed=3, re_specs={}, seed=11)
+    write_avro_file(
+        str(tmp_path / "part-00000.avro"),
+        TRAINING_EXAMPLE_AVRO,
+        generate_game_records(data),
+    )
+    build_fixed_effect_dataset_from_disk(
+        str(tmp_path),
+        {"global": FeatureShardConfig(feature_bags=("features",))},
+        "global",
+        "global",
+        1 << 20,
+        layout="coo",
+    )
+
+
 def _trigger_serving_store_version(raw, tmp_path):
     import json as _json
 
@@ -271,6 +293,12 @@ CASES = [
         "pipeline.depth=2 is not supported with --distributed",
         ValueError,
         _trigger_pipeline_distributed,
+    ),
+    (
+        "disk-slice-bad-layout",
+        "the disk-to-slice ingest path requires a row-sliceable layout",
+        ValueError,
+        _trigger_disk_slice_bad_layout,
     ),
 ]
 
