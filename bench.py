@@ -774,32 +774,22 @@ def bench_multichip(mesh_sizes=(1, 2, 4, 8)) -> dict:
     }
 
 
-def bench_serving(
-    duration_s=3.0,
-    n_clients=8,
+def _serving_workload(
     d_fixed=1024,
     n_users=20_000,
     d_re=32,
     unseen_frac=0.2,
-    max_batch=256,
-    max_latency_ms=2.0,
+    n_requests=4096,
+    nnz_fe=16,
+    nnz_re=4,
 ):
-    """Resident scoring service on one chip: sustained scores/s and request
-    p99 at a fixed seen/unseen entity mix (cold-start requests fall back to
-    the fixed effect). ``n_clients`` closed-loop threads hammer the
-    microbatcher for ``duration_s`` after warmup; latency quantiles come
-    from the ``photon_serving_request_latency_seconds`` histogram the
-    service itself exports (the same numbers a production scrape would see).
-
-    value = sustained scores/s; vs_baseline = batched rate / sequential
-    single-request rate through the same engine (what microbatching buys
-    over a naive request-at-a-time server)."""
-    import tempfile
-    import threading
-
+    """The shared serving-bench model + request mix: a GLMix with a dense
+    fixed effect and a per-user random effect, plus ``n_requests`` sparse
+    score requests at a fixed seen/unseen entity mix (cold-start requests
+    fall back to the fixed effect). Returns (game_model, requests)."""
     import jax.numpy as jnp
 
-    from photon_ml_tpu import obs, serving
+    from photon_ml_tpu import serving
     from photon_ml_tpu.models.game import (
         FixedEffectModel,
         GameModel,
@@ -828,8 +818,6 @@ def bench_serving(
     )
     gm = GameModel(models={"global": fe, "per-user": re}, task="logistic_regression")
 
-    n_requests = 4096
-    nnz_fe, nnz_re = 16, 4
     requests = []
     for i in range(n_requests):
         uid = (
@@ -852,6 +840,43 @@ def bench_serving(
                 ids={"userId": uid},
             )
         )
+    return gm, requests
+
+
+def bench_serving(
+    duration_s=3.0,
+    n_clients=8,
+    d_fixed=1024,
+    n_users=20_000,
+    d_re=32,
+    unseen_frac=0.2,
+    max_batch=256,
+    max_latency_ms=2.0,
+):
+    """Resident scoring service on one chip: sustained scores/s and request
+    p99 at a fixed seen/unseen entity mix (cold-start requests fall back to
+    the fixed effect). ``n_clients`` closed-loop threads hammer the
+    microbatcher for ``duration_s`` after warmup; latency quantiles come
+    from the ``photon_serving_request_latency_seconds`` histogram the
+    service itself exports (the same numbers a production scrape would see).
+
+    value = sustained scores/s; vs_baseline = batched rate / sequential
+    single-request rate through the same engine (what microbatching buys
+    over a naive request-at-a-time server).
+
+    NOTE the closed-loop cap this protocol carries: ``n_clients`` clients
+    can never have more than ``n_clients`` requests in flight, so the mean
+    batch tops out at ``n_clients`` and offered load always equals served
+    load — use ``--config serving-openloop`` for saturation behavior."""
+    import tempfile
+    import threading
+
+    from photon_ml_tpu import obs, serving
+
+    gm, requests = _serving_workload(
+        d_fixed=d_fixed, n_users=n_users, d_re=d_re, unseen_frac=unseen_frac
+    )
+    n_requests = len(requests)
 
     with tempfile.TemporaryDirectory() as tmp:
         serving.build_store_from_model(gm, tmp)
@@ -922,6 +947,174 @@ def bench_serving(
                 f"single-request baseline {seq_rate:.0f}/s)"
             ),
             "vs_baseline": round(rate / max(seq_rate, 1e-9), 2),
+        }
+
+
+def bench_serving_openloop(
+    step_duration_s=2.0,
+    d_fixed=1024,
+    n_users=20_000,
+    d_re=32,
+    unseen_frac=0.2,
+    max_batch=256,
+    max_latency_ms=2.0,
+    max_pending=512,
+    deadline_ms=100.0,
+    load_fractions=(0.25, 0.5, 0.75, 1.0, 1.3, 1.7),
+):
+    """Open-loop load sweep over the resident scorer: Poisson arrivals at a
+    target offered QPS, latency measured from each request's INTENDED send
+    time (serving.loadgen), so queueing past saturation shows up in p99
+    instead of being coordinatedly omitted by a closed-loop client.
+
+    Protocol: probe the server's drain capacity with a burst, then sweep
+    offered load at ``load_fractions`` of that capacity with a
+    ``deadline_ms`` budget on every request. The saturation knee is the
+    highest offered step the server still serves (served >= 90% of
+    offered); the final (past-knee) step shows the admission controller at
+    work — excess load shed with counted refusals while admitted-request
+    p99 stays within a bounded factor of the at-knee p99.
+
+    value = knee offered QPS; vs_baseline = past-knee admitted p99 / knee
+    p99 (the bounded-degradation factor the overload tests pin)."""
+    import tempfile
+
+    from photon_ml_tpu import obs, serving
+
+    gm, requests = _serving_workload(
+        d_fixed=d_fixed, n_users=n_users, d_re=d_re, unseen_frac=unseen_frac
+    )
+
+    def _shed_totals(reg):
+        out = {}
+        for e in reg.snapshot():
+            if e["name"] == "photon_serving_shed_total":
+                reason = e.get("labels", {}).get("reason", "")
+                out[reason] = out.get(reason, 0) + int(e["value"])
+        return out
+
+    def _batch_hist(reg):
+        for e in reg.snapshot():
+            if e["name"] == "photon_serving_batch_size":
+                return float(e["sum"]), int(e["count"])
+        return 0.0, 0
+
+    with tempfile.TemporaryDirectory() as tmp:
+        serving.build_store_from_model(gm, tmp)
+        store = serving.ModelStore.open(tmp)
+        run = obs.RunTelemetry()
+        with obs.use_run(run):
+            reg = run.registry
+            server = serving.ScoringServer(
+                store=store,
+                max_batch=max_batch,
+                max_latency_ms=max_latency_ms,
+                max_pending=max_pending,
+            )
+            try:
+                # warm + capacity probe: a burst of admitted requests with a
+                # generous deadline fills batches toward max_batch and
+                # measures the drain rate the sweep is scaled against
+                server.submit(requests[0], deadline_s=60.0).result(timeout=60.0)
+                # chunks of max_batch so the probe itself never trips the
+                # max_pending admission bound it is calibrating against
+                chunk = min(max_batch, max_pending)
+                probe_n = 0
+                t0 = time.perf_counter()
+                for lo in range(0, min(4 * max_batch, len(requests)), chunk):
+                    futs = [
+                        server.submit(r, deadline_s=60.0)
+                        for r in requests[lo : lo + chunk]
+                    ]
+                    for f in futs:
+                        f.result(timeout=60.0)
+                    probe_n += len(futs)
+                capacity = probe_n / (time.perf_counter() - t0)
+
+                steps = []
+                per_step_batch = []
+                deadline_s = deadline_ms / 1e3
+                for i, frac in enumerate(sorted(load_fractions)):
+                    b_sum0, b_cnt0 = _batch_hist(reg)
+                    res = serving.run_open_loop(
+                        server.submit,
+                        requests,
+                        offered_qps=max(frac * capacity, 1.0),
+                        duration_s=step_duration_s,
+                        seed=i,
+                        deadline_s=deadline_s,
+                    )
+                    # the accounting invariant the chaos tests also pin: no
+                    # request without a response
+                    assert res.sent == (
+                        res.completed + res.shed_total + res.errors
+                    ), f"openloop lost responses at step {i}: {res}"
+                    b_sum1, b_cnt1 = _batch_hist(reg)
+                    per_step_batch.append(
+                        (b_sum1 - b_sum0) / max(b_cnt1 - b_cnt0, 1)
+                    )
+                    steps.append(res)
+                sheds = _shed_totals(reg)
+            finally:
+                server.close()
+
+        knee = serving.find_knee(steps)
+        if knee is None:  # even the lightest step saturated: report it
+            knee = steps[0]
+        knee_i = steps.index(knee)
+        past = steps[-1]
+        client_shed = sum(
+            sum(s.shed_admission.values()) + s.shed_expired for s in steps
+        )
+        counted_shed = sum(sheds.values())
+        assert counted_shed >= client_shed, (
+            f"refusals uncounted: client saw {client_shed}, "
+            f"photon_serving_shed_total has {counted_shed}"
+        )
+        p99_factor = past.latency_p99_s / max(knee.latency_p99_s, 1e-9)
+        # the bounded-degradation guarantee the admission controller makes:
+        # an admitted request's queue wait fits its deadline budget, so
+        # past-knee p99 stays within deadline + one batch of service — 2x
+        # the budget is generous slack for scheduling noise
+        assert past.latency_p99_s <= 2.0 * deadline_s, (
+            f"past-knee admitted p99 {past.latency_p99_s * 1e3:.1f}ms "
+            f"escaped the {deadline_ms:.0f}ms deadline budget"
+        )
+        batch_trail = "/".join(f"{b:.1f}" for b in per_step_batch)
+        shed_str = ",".join(f"{k}={v}" for k, v in sorted(sheds.items())) or "none"
+        return {
+            "metric": "serving_openloop_knee_qps",
+            "value": round(knee.offered_qps, 1),
+            "unit": (
+                f"offered QPS at the saturation knee (served "
+                f"{knee.served_qps:.0f}/s = {knee.served_fraction:.0%} of "
+                f"offered; {step_duration_s:.0f}s Poisson steps at "
+                f"{'/'.join(f'{f:g}x' for f in sorted(load_fractions))} of "
+                f"{capacity:.0f}/s probed capacity, deadline {deadline_ms:.0f}ms, "
+                f"max_pending={max_pending}; knee p99 "
+                f"{knee.latency_p99_s * 1e3:.2f}ms from intended send time, "
+                f"mean batch {batch_trail} rows per step climbing under "
+                f"max_batch={max_batch}; past-knee "
+                f"{past.offered_qps:.0f}/s offered -> {past.served_qps:.0f}/s "
+                f"served, admitted p99 {past.latency_p99_s * 1e3:.2f}ms = "
+                f"{p99_factor:.2f}x knee, sheds {shed_str}; every refusal "
+                f"counted, zero lost responses)"
+            ),
+            "vs_baseline": round(p99_factor, 2),
+            "quadrants": {
+                "knee": {
+                    "offered_qps": round(knee.offered_qps, 1),
+                    "served_per_sec": round(knee.served_qps, 1),
+                    "admitted_p99_latency_sec": round(knee.latency_p99_s, 6),
+                    "mean_batch_rows": round(per_step_batch[knee_i], 2),
+                },
+                "past_knee": {
+                    "served_per_sec": round(past.served_qps, 1),
+                    "admitted_p99_latency_sec": round(past.latency_p99_s, 6),
+                    "p99_over_knee_factor": round(p99_factor, 3),
+                    "mean_batch_rows": round(per_step_batch[-1], 2),
+                },
+            },
         }
 
 
@@ -1240,23 +1433,41 @@ def load_bench_record(path: str) -> dict:
 
 def _lower_is_better(name: str) -> bool:
     """Direction of improvement from the series name: wall/latency seconds
-    regress upward, throughput (examples/sec, scores/sec, GB/s) and overlap
-    factors/ratios downward (more of the stage wall hidden = better)."""
+    and latency quantiles (p50/p99, *_ms) regress upward; throughput
+    (examples/sec, scores/sec, GB/s, QPS — knee and served) and overlap
+    factors/ratios regress downward (more served / more hidden = better)."""
     n = name.lower()
-    if "per_sec" in n or "/s" in n or "overlap" in n:
+    if "per_sec" in n or "/s" in n or "overlap" in n or "qps" in n:
         return False
-    return n.endswith("_sec") or n.endswith("_seconds") or "latency" in n or "wall" in n
+    return (
+        n.endswith("_sec")
+        or n.endswith("_seconds")
+        or n.endswith("_ms")
+        or "latency" in n
+        or "wall" in n
+        or "p50" in n
+        or "p99" in n
+    )
 
 
 def _diff_one(name: str, old_v: float, new_v: float, tolerance: float) -> dict:
     lower_better = _lower_is_better(name)
-    # direction self-check: an overlap or rows/s series that ever classifies
-    # as lower-is-better would flag pipelining/ingest IMPROVEMENTS as
-    # regressions — fail the diff loudly instead of inverting the gate
-    if ("overlap" in name.lower() or "rows_per_sec" in name.lower()) and lower_better:
+    # direction self-check: an overlap/rows-per-sec/QPS series that ever
+    # classifies as lower-is-better would flag pipelining, ingest, or
+    # saturation-knee IMPROVEMENTS as regressions — and a p99/millisecond
+    # series classifying higher-is-better would wave real latency
+    # regressions through. Fail the diff loudly instead of inverting the
+    # gate either way.
+    nl = name.lower()
+    if ("overlap" in nl or "rows_per_sec" in nl or "qps" in nl) and lower_better:
         raise AssertionError(
             f"--diff direction check: series {name!r} must be "
             "higher-is-better"
+        )
+    if ("p99" in nl or nl.endswith("_ms")) and not lower_better:
+        raise AssertionError(
+            f"--diff direction check: series {name!r} must be "
+            "lower-is-better"
         )
     if old_v == 0:
         delta = 0.0 if new_v == 0 else float("inf")
@@ -1346,7 +1557,7 @@ def main(argv: Optional[List[str]] = None):
         "--config",
         choices=[
             "glmix", "sparse", "billion", "tiled", "hbm", "streamed-fe",
-            "serving", "multichip", "ingest",
+            "serving", "serving-openloop", "multichip", "ingest",
         ],
         default="glmix",
     )
@@ -1467,6 +1678,9 @@ def main(argv: Optional[List[str]] = None):
         return
     if a.config == "serving":
         print(json.dumps(bench_serving()))
+        return
+    if a.config == "serving-openloop":
+        print(json.dumps(bench_serving_openloop()))
         return
     if a.config == "ingest":
         print(json.dumps(bench_ingest()))

@@ -15,6 +15,16 @@ Typical flow::
     # resident server over an AF_UNIX socket
     python -m photon_ml_tpu.cli.serve --serving-root out/serving \
         --socket /tmp/photon-serve.sock --metrics-out out/serving-metrics
+
+    # ... or a TCP listener, with a 50ms deadline budget on every request
+    python -m photon_ml_tpu.cli.serve --serving-root out/serving \
+        --listen 127.0.0.1:8473 --default-deadline-ms 50
+
+Overload posture: the admission controller sheds requests that cannot meet
+their deadline budget (``--default-deadline-ms``, or per-request
+``deadline_ms`` on the socket) or that meet a full pending queue
+(``--max-pending``); ``--overload-shed-threshold`` wires the shed rate into
+``/healthz`` so a balancer can route around a saturated replica.
 """
 
 from __future__ import annotations
@@ -30,6 +40,15 @@ from ..io.index_map import load_partitioned
 from ..utils.logging import setup_logging
 
 logger = logging.getLogger("photon_ml_tpu")
+
+
+def check_socket_front(socket_path, listen) -> None:
+    """One socket front per server process: AF_UNIX or TCP, not both."""
+    if socket_path and listen:
+        raise ValueError(
+            "pass at most one of --socket / --listen (one socket front per "
+            "server process)"
+        )
 
 
 def build_parser() -> argparse.ArgumentParser:
@@ -60,8 +79,35 @@ def build_parser() -> argparse.ArgumentParser:
         help="publish the snapshot and exit without serving",
     )
     p.add_argument("--socket", default=None, help="AF_UNIX socket path to serve on")
+    p.add_argument(
+        "--listen",
+        default=None,
+        help="TCP host:port to serve on (same JSON-lines protocol as "
+        "--socket; port 0 binds ephemeral)",
+    )
     p.add_argument("--max-batch", type=int, default=256)
     p.add_argument("--max-latency-ms", type=float, default=2.0)
+    p.add_argument(
+        "--max-pending",
+        type=int,
+        default=1024,
+        help="admission queue bound; submits against a full queue are shed "
+        "with reason queue_full",
+    )
+    p.add_argument(
+        "--default-deadline-ms",
+        type=float,
+        default=None,
+        help="deadline budget applied to requests that don't carry their own "
+        "deadline_ms; requests that cannot meet it are shed immediately",
+    )
+    p.add_argument(
+        "--overload-shed-threshold",
+        type=float,
+        default=None,
+        help="sheds/second above which /healthz answers 503 "
+        '{"status": "overloaded"} (needs --status-port)',
+    )
     p.add_argument("--poll-seconds", type=float, default=0.2)
     p.add_argument(
         "--metrics-out",
@@ -83,12 +129,19 @@ def build_parser() -> argparse.ArgumentParser:
 
 def run(argv: Optional[List[str]] = None, stop_event=None):
     args = build_parser().parse_args(argv)
+    check_socket_front(args.socket, args.listen)
     setup_logging(args.log_level, args.log_file)
     from ..utils.compile_cache import enable_persistent_compilation_cache
 
     enable_persistent_compilation_cache()
 
     from .. import obs, serving
+    from ..robust import faults
+
+    # PHOTON_FAULTS reaches the serving sites (serving.score /
+    # serving.refresh) the same way it reaches training: the chaos drills
+    # run against the real CLI entrypoint
+    faults.install_from_env()
 
     if args.publish_model:
         if not args.serving_root:
@@ -120,6 +173,11 @@ def run(argv: Optional[List[str]] = None, stop_event=None):
             obs.PrometheusSink(os.path.join(args.metrics_out, "metrics.prom"))
         )
     with obs.use_run(run_ctx):
+        admission = dict(
+            max_pending=args.max_pending,
+            default_deadline_ms=args.default_deadline_ms,
+            overload_shed_threshold=args.overload_shed_threshold,
+        )
         if args.serving_root:
             server = serving.ScoringServer(
                 serving_root=args.serving_root,
@@ -127,6 +185,7 @@ def run(argv: Optional[List[str]] = None, stop_event=None):
                 max_latency_ms=args.max_latency_ms,
                 poll_seconds=args.poll_seconds,
                 status_port=args.status_port,
+                **admission,
             )
         else:
             server = serving.ScoringServer(
@@ -134,9 +193,11 @@ def run(argv: Optional[List[str]] = None, stop_event=None):
                 max_batch=args.max_batch,
                 max_latency_ms=args.max_latency_ms,
                 status_port=args.status_port,
+                **admission,
             )
         logger.info(
-            "serving snapshot %s (socket=%s)", server.snapshot_name, args.socket
+            "serving snapshot %s (socket=%s listen=%s)",
+            server.snapshot_name, args.socket, args.listen,
         )
         if server.status_port is not None:
             logger.info(
@@ -144,8 +205,14 @@ def run(argv: Optional[List[str]] = None, stop_event=None):
                 "healthz,statusz}", server.status_port,
             )
         try:
-            if args.socket:
-                serving.serve_socket(server, args.socket, stop_event=stop_event)
+            if args.socket or args.listen:
+                serving.serve_socket(
+                    server,
+                    path=args.socket,
+                    listen=args.listen,
+                    stop_event=stop_event,
+                    on_bound=lambda b: logger.info("socket front bound: %s", b),
+                )
             elif stop_event is not None:
                 stop_event.wait()
             else:

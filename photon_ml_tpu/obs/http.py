@@ -6,16 +6,22 @@
 it is happening* instead of reading metric files after the fact:
 
 - ``/metrics``  — Prometheus text exposition rendered from the live registry
-- ``/healthz``  — liveness probe, ``{"status": "ok"}``; returns 503 while
-  the StatusBoard's ``refresh_in_progress`` flag is set (the serving side
-  raises it around a snapshot-refresh engine flip so load balancers drain
-  traffic for exactly the flip window)
+- ``/healthz``  — liveness probe, ``{"status": "ok"}``; returns 503
+  ``{"status": "refreshing"}`` while the StatusBoard's
+  ``refresh_in_progress`` flag is set (the serving side raises it around a
+  snapshot-refresh engine flip so load balancers drain traffic for exactly
+  the flip window), and 503 ``{"status": "overloaded"}`` while the
+  scrape-delta shed rate exceeds the board's ``overload_shed_threshold``
+  (sheds/second; set by ``ScoringServer(overload_shed_threshold=...)``) —
+  admission control keeps refusing locally, this tells the balancer to
+  route around the replica
 - ``/statusz``  — JSON runtime status: current sweep / coordinate and
   accepted losses (from the run's StatusBoard), rejection / divergence
   counters and stream-slice progress (derived from the registry), a
   ``memory`` section (live host RSS + recorded HBM watermarks and
   hbm.budget headroom when streaming), and — when serving metrics exist —
-  request QPS and latency quantiles.
+  offered vs served vs shed request QPS (scrape-delta), latency quantiles,
+  and the live admission-queue depth / drain estimate.
 
 All handlers read snapshots under the registry/board locks, never the live
 structures, so a scrape can never block or torn-read the training thread.
@@ -52,8 +58,21 @@ def _sum_counter(snapshot, name: str, label: Optional[str] = None):
     return out
 
 
-def compose_statusz(run: RunTelemetry, qps: Optional[float] = None) -> dict:
-    """Build the /statusz JSON document from a run's board + registry."""
+def _gauge_value(snapshot, name: str) -> Optional[float]:
+    for m in snapshot:
+        if m["name"] == name and m["kind"] == "gauge":
+            return m["value"]
+    return None
+
+
+def compose_statusz(
+    run: RunTelemetry,
+    qps: Optional[float] = None,
+    rates: Optional[Dict[str, float]] = None,
+) -> dict:
+    """Build the /statusz JSON document from a run's board + registry.
+    ``rates`` carries the caller's scrape-delta rates (offered_qps /
+    served_qps / shed_qps); ``qps`` is the legacy served-rate argument."""
     snap = run.registry.snapshot()
     doc: dict = {"status": "ok", "unix_time": time.time()}
     doc.update(run.status.snapshot())
@@ -89,13 +108,35 @@ def compose_statusz(run: RunTelemetry, qps: Optional[float] = None) -> dict:
 
     serving: dict = {}
     requests = _sum_counter(snap, "photon_serving_requests_total")
-    if requests:
+    offered = _sum_counter(snap, "photon_serving_offered_total")
+    if requests or offered:
         serving["requests_total"] = int(requests)
         serving["errors_total"] = int(
             _sum_counter(snap, "photon_serving_request_errors_total")
         )
         if qps is not None:
             serving["qps"] = qps
+    if offered:
+        serving["offered_total"] = int(offered)
+        shed_by_reason = _sum_counter(snap, "photon_serving_shed_total", "reason")
+        serving["shed_total"] = int(sum(shed_by_reason.values()))
+        if shed_by_reason:
+            serving["shed_by_reason"] = {
+                k: int(v) for k, v in shed_by_reason.items()
+            }
+    bad = _sum_counter(snap, "photon_serving_bad_request_total", "kind")
+    if bad:
+        serving["bad_requests"] = {k: int(v) for k, v in bad.items()}
+    for key, value in (rates or {}).items():
+        serving[key] = value
+    queue_depth = _gauge_value(snap, "photon_serving_queue_depth")
+    if queue_depth is not None:
+        serving["admission"] = {
+            "queue_depth": int(queue_depth),
+            "drain_estimate_seconds": _gauge_value(
+                snap, "photon_serving_drain_estimate_seconds"
+            ),
+        }
     for m in snap:
         if m["name"] == "photon_serving_request_latency_seconds" and m["kind"] == "histogram":
             for q in _QUANTILES:
@@ -121,7 +162,10 @@ class IntrospectionServer:
     ) -> None:
         self._run = run
         self._qps_lock = threading.Lock()
-        self._qps_state: Optional[tuple] = None  # (monotonic, requests_total)
+        # scrape-delta states: (monotonic, totals...) per consumer — statusz
+        # and healthz scrape on independent cadences, so each keeps its own
+        self._qps_state: Optional[tuple] = None  # (t, requests, offered, shed)
+        self._health_state: Optional[tuple] = None  # (t, shed_total)
         server = self
 
         class Handler(http.server.BaseHTTPRequestHandler):
@@ -134,8 +178,23 @@ class IntrospectionServer:
                     # 503 while a serving snapshot-refresh flip is
                     # mid-publish: the board flag brackets exactly the
                     # build+warm+swap window (serving/server.py _install)
-                    if server.run().status.snapshot().get("refresh_in_progress"):
-                        body = json.dumps({"status": "refreshing"}).encode(
+                    unhealthy = None
+                    board = server.run().status.snapshot()
+                    if board.get("refresh_in_progress"):
+                        unhealthy = "refreshing"
+                    else:
+                        # 503 while admission control is shedding faster
+                        # than the configured threshold (sheds/second,
+                        # scrape-delta): the replica still answers every
+                        # request it admits, this tells the balancer to
+                        # back off until the shed rate drops
+                        threshold = board.get("overload_shed_threshold")
+                        if threshold is not None:
+                            rate = server._shed_rate(server.run())
+                            if rate is not None and rate > float(threshold):
+                                unhealthy = "overloaded"
+                    if unhealthy is not None:
+                        body = json.dumps({"status": unhealthy}).encode(
                             "utf-8"
                         )
                         self.send_response(503)
@@ -182,18 +241,43 @@ class IntrospectionServer:
 
     def statusz(self) -> dict:
         run = self.run()
-        qps = self._update_qps(run)
-        return compose_statusz(run, qps=qps)
+        return compose_statusz(run, rates=self._update_rates(run))
 
-    def _update_qps(self, run: RunTelemetry) -> Optional[float]:
-        """Serving QPS from the requests_total delta between scrapes."""
-        total = _sum_counter(
-            run.registry.snapshot(), "photon_serving_requests_total"
-        )
+    def _update_rates(self, run: RunTelemetry) -> Optional[Dict[str, float]]:
+        """Serving rates (served ``qps``, plus ``offered_qps`` / ``shed_qps``
+        when admission control is in play) from counter deltas between
+        scrapes. None on the first scrape — a rate needs two samples."""
+        snap = run.registry.snapshot()
+        served = _sum_counter(snap, "photon_serving_requests_total")
+        offered = _sum_counter(snap, "photon_serving_offered_total")
+        shed = _sum_counter(snap, "photon_serving_shed_total")
         now = time.monotonic()
         with self._qps_lock:
             prev = self._qps_state
-            self._qps_state = (now, total)
+            self._qps_state = (now, served, offered, shed)
+        if prev is None or now <= prev[0]:
+            return None
+        if not (served or offered):
+            return None  # no serving traffic: keep /statusz free of a
+            # zero-rate serving section on training runs
+        dt = now - prev[0]
+        rates = {"qps": max(0.0, (served - prev[1]) / dt)}
+        if offered or prev[2]:
+            rates["offered_qps"] = max(0.0, (offered - prev[2]) / dt)
+            rates["shed_qps"] = max(0.0, (shed - prev[3]) / dt)
+        return rates
+
+    def _shed_rate(self, run: RunTelemetry) -> Optional[float]:
+        """Scrape-delta shed rate (sheds/second) for the /healthz overload
+        probe; keeps its own state so health and statusz cadences don't
+        perturb each other's deltas."""
+        total = _sum_counter(
+            run.registry.snapshot(), "photon_serving_shed_total"
+        )
+        now = time.monotonic()
+        with self._qps_lock:
+            prev = self._health_state
+            self._health_state = (now, total)
         if prev is None or now <= prev[0]:
             return None
         return max(0.0, (total - prev[1]) / (now - prev[0]))

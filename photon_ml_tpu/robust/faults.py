@@ -25,7 +25,7 @@ Activation:
 Spec grammar (comma-separated clauses)::
 
     SITE:KIND:WHEN
-    KIND = io | kill | nan
+    KIND = io | kill | nan | delay[MS]
     WHEN = N      fire on the N-th call to the site (1-based)
          | NxM    fire on calls N..N+M-1 (M consecutive transient errors)
          | pF     fire on each call with probability F (seeded, so the
@@ -34,6 +34,15 @@ Spec grammar (comma-separated clauses)::
 ``io.avro_read:io:1x2`` fails the first two Avro reads then lets the third
 succeed; ``cd.boundary:kill:3:`` kills the process at the third
 coordinate-update boundary.
+
+The ``delay`` kind never raises either: it sleeps at the site (``delay`` =
+50 ms, ``delay200`` = 200 ms) and returns, simulating a slow dependency
+instead of a broken one. The serving plane carries two such sites —
+``serving.score`` (in the microbatcher, just before the engine call: a
+delay storm there is the slow-engine chaos drill that drives the admission
+controller past its deadline budget) and ``serving.refresh`` (in the
+snapshot watcher: a delay stalls a flip, an ``io`` error there is swallowed
+and retried next poll while the live model keeps serving).
 
 The ``nan`` kind never raises: it acts through :func:`corrupt`, which sites
 holding concrete arrays call as ``tree = faults.corrupt(site, tree)``. When
@@ -52,6 +61,7 @@ import dataclasses
 import os
 import random
 import threading
+import time
 from typing import Dict, List, Optional
 
 
@@ -68,16 +78,21 @@ class SimulatedKill(BaseException):
 @dataclasses.dataclass(frozen=True)
 class FaultSpec:
     site: str
-    kind: str  # "io" | "kill" | "nan"
+    kind: str  # "io" | "kill" | "nan" | "delay"
     at: int = 1  # first firing call index, 1-based ("NxM" / "N" forms)
     times: int = 1  # consecutive firings from ``at``
     prob: Optional[float] = None  # "pF" form: seeded per-call probability
+    delay_s: float = 0.05  # "delay" kind: sleep length at the site
 
     def __post_init__(self):
-        if self.kind not in ("io", "kill", "nan"):
-            raise ValueError(f"fault kind must be io|kill|nan: {self.kind!r}")
+        if self.kind not in ("io", "kill", "nan", "delay"):
+            raise ValueError(
+                f"fault kind must be io|kill|nan|delay[MS]: {self.kind!r}"
+            )
         if self.prob is None and self.at < 1:
             raise ValueError(f"fault index is 1-based: {self.at}")
+        if self.delay_s < 0:
+            raise ValueError(f"fault delay must be >= 0: {self.delay_s}")
 
 
 def parse_faults(spec: str) -> List[FaultSpec]:
@@ -94,13 +109,25 @@ def parse_faults(spec: str) -> List[FaultSpec]:
                 "(e.g. io.avro_read:io:1x2)"
             )
         site, kind, when = (p.strip() for p in parts)
+        extra = {}
+        if kind.startswith("delay"):
+            ms = kind[len("delay"):]
+            kind = "delay"
+            if ms:
+                extra["delay_s"] = float(ms) / 1e3
         if when.startswith("p"):
-            out.append(FaultSpec(site=site, kind=kind, prob=float(when[1:])))
+            out.append(
+                FaultSpec(site=site, kind=kind, prob=float(when[1:]), **extra)
+            )
         elif "x" in when:
             at, times = when.split("x", 1)
-            out.append(FaultSpec(site=site, kind=kind, at=int(at), times=int(times)))
+            out.append(
+                FaultSpec(
+                    site=site, kind=kind, at=int(at), times=int(times), **extra
+                )
+            )
         else:
-            out.append(FaultSpec(site=site, kind=kind, at=int(when)))
+            out.append(FaultSpec(site=site, kind=kind, at=int(when), **extra))
     return out
 
 
@@ -146,21 +173,33 @@ class FaultInjector:
             raise SimulatedKill(f"injected kill at site {site!r} (call {n})")
         raise InjectedIOError(f"injected IO error at site {site!r} (call {n})")
 
+    def _sleep(self, fire: FaultSpec, site: str) -> None:
+        _count_injection(site, "delay")
+        time.sleep(fire.delay_s)
+
     def hit(self, site: str) -> None:
         """Record one call at ``site``; raise if a spec says this call fails.
-        ``nan`` specs never fire here — a check-only site holds no arrays to
-        corrupt; they act through :meth:`corrupt`."""
+        ``delay`` specs sleep instead of raising; ``nan`` specs never fire
+        here — a check-only site holds no arrays to corrupt; they act
+        through :meth:`corrupt`."""
         fire, n = self._schedule(site)
         if fire is None or fire.kind == "nan":
+            return
+        if fire.kind == "delay":
+            self._sleep(fire, site)
             return
         self._raise(fire, site, n)
 
     def corrupt(self, site: str, tree):
         """Record one call at ``site``; return ``tree`` with NaN planted into
         its floating-point array leaves when a ``nan`` spec fires (io/kill
-        specs at a corrupt site raise exactly as :meth:`hit` would)."""
+        specs at a corrupt site raise exactly as :meth:`hit` would, delay
+        specs sleep and pass the tree through untouched)."""
         fire, n = self._schedule(site)
         if fire is None:
+            return tree
+        if fire.kind == "delay":
+            self._sleep(fire, site)
             return tree
         if fire.kind != "nan":
             self._raise(fire, site, n)

@@ -7,13 +7,28 @@ Pieces, composable or standalone:
   independent of entity count.
 - ``engine``  — the one compiled score assembly, shared by batch scoring
   (``cli.score`` / ``GameTransformer``) and the resident request path.
-- ``batcher`` — microbatching under a max-latency / max-batch policy.
+- ``batcher`` — microbatching under a max-latency / max-batch policy, with
+  deadline-budget admission control (bounded queue, typed ``ShedError``
+  refusals).
 - ``refresh`` — atomic snapshot publication + zero-downtime flips.
-- ``server``  — the composed resident service (+ AF_UNIX JSON-lines front).
+- ``server``  — the composed resident service (+ AF_UNIX / TCP JSON-lines
+  front).
+- ``loadgen`` — open-loop Poisson load generation measuring latency from
+  intended send time (the coordinated-omission-proof harness behind
+  ``bench.py --config serving-openloop``).
 """
 
-from .batcher import SERVING_LATENCY_BUCKETS, MicroBatcher
+from .batcher import SERVING_LATENCY_BUCKETS, MicroBatcher, ShedError
 from .engine import LADDER_ROWS, LADDER_WIDTH, ScoreEngine, ScoreRequest
+from .loadgen import (
+    OpenLoopResult,
+    find_knee,
+    poisson_intended_times,
+    run_open_loop,
+    simulate_fifo_closed_loop,
+    simulate_fifo_open_loop,
+    sweep_open_loop,
+)
 from .refresh import (
     RefreshWatcher,
     current_snapshot,
@@ -21,7 +36,12 @@ from .refresh import (
     publish_snapshot,
     snapshot_path,
 )
-from .server import ScoringServer, serve_socket
+from .server import (
+    MAX_REQUEST_LINE_BYTES,
+    BadRequestError,
+    ScoringServer,
+    serve_socket,
+)
 from .store import (
     ModelStore,
     build_store,
@@ -32,15 +52,25 @@ from .store import (
 __all__ = [
     "SERVING_LATENCY_BUCKETS",
     "MicroBatcher",
+    "ShedError",
     "LADDER_ROWS",
     "LADDER_WIDTH",
     "ScoreEngine",
     "ScoreRequest",
+    "OpenLoopResult",
+    "find_knee",
+    "poisson_intended_times",
+    "run_open_loop",
+    "simulate_fifo_closed_loop",
+    "simulate_fifo_open_loop",
+    "sweep_open_loop",
     "RefreshWatcher",
     "current_snapshot",
     "open_current",
     "publish_snapshot",
     "snapshot_path",
+    "MAX_REQUEST_LINE_BYTES",
+    "BadRequestError",
     "ScoringServer",
     "serve_socket",
     "ModelStore",

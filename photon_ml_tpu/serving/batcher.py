@@ -1,4 +1,5 @@
-"""Request microbatching for the resident scorer.
+"""Request microbatching + deadline-budget admission control for the
+resident scorer.
 
 Concurrent callers submit single requests; one worker thread drains them
 into batches under a max-latency / max-batch policy (the serving analogue of
@@ -8,11 +9,32 @@ batch is scored by ONE engine reference captured at drain time — the
 atomicity unit of a zero-downtime model flip: a refresh swaps the engine
 *between* batches, so no batch can mix coefficients from two snapshots.
 
+Past the saturation knee an unbounded queue converts overload into unbounded
+tail latency for *everyone*; this batcher refuses instead of queueing:
+
+- the pending queue is bounded (``max_pending``); a submit against a full
+  queue is shed with reason ``queue_full``;
+- each request may carry a deadline budget. Admission estimates the queue's
+  drain time from a live service-rate EWMA (batch wall / batch rows, updated
+  after every scored batch) and sheds immediately — reason ``deadline`` —
+  when the request could not be scored inside its budget anyway;
+- requests whose deadline expires *while queued* (the estimate is an
+  estimate) are shed at drain time with reason ``expired``, before the
+  engine ever sees them — never scored late, never silently dropped.
+
+Every shed is a typed :class:`ShedError` (callers and the socket front can
+tell refusal from failure) and a counted refusal in
+``photon_serving_shed_total{reason=}``; offered load lands in
+``photon_serving_offered_total`` whether admitted or not, so
+offered-vs-served-vs-shed rates are all derivable from one scrape.
+
 Every completed request lands in the obs layer:
 ``photon_serving_request_latency_seconds`` (histogram, enqueue->result),
 ``photon_serving_batch_size`` (histogram), ``photon_serving_requests_total``
-and ``photon_serving_request_errors_total`` (counters). The Prometheus
-exposition renders p50/p95/p99 gauges for every histogram family.
+and ``photon_serving_request_errors_total`` (counters), plus live
+``photon_serving_queue_depth`` / ``photon_serving_drain_estimate_seconds``
+gauges for the admission queue. The Prometheus exposition renders
+p50/p95/p99 gauges for every histogram family.
 """
 
 from __future__ import annotations
@@ -24,6 +46,7 @@ from concurrent.futures import Future
 from typing import Callable, List, Optional, Tuple
 
 from .. import obs
+from ..robust import faults
 from .engine import ScoreEngine, ScoreRequest
 
 # Serving latencies are sub-millisecond to tens of ms — the seconds-scale
@@ -34,38 +57,128 @@ SERVING_LATENCY_BUCKETS: Tuple[float, ...] = (
     0.1, 0.25, 1.0, 5.0,
 )
 
+_SHED_HELP = "requests refused by admission control instead of queued to death"
+_OFFERED_HELP = "requests offered to the batcher (admitted + shed)"
+
+
+class ShedError(RuntimeError):
+    """A request refused by admission control (reason: ``queue_full`` — the
+    bounded pending queue was full; ``deadline`` — the drain-time estimate
+    said the deadline budget could not be met; ``expired`` — the deadline
+    passed while the request waited in the queue). A shed is a *refusal
+    with a response*, distinct from an engine failure."""
+
+    def __init__(self, reason: str, message: str):
+        super().__init__(message)
+        self.reason = reason
+
 
 class MicroBatcher:
-    """Queue + worker thread turning concurrent requests into engine calls."""
+    """Queue + worker thread turning concurrent requests into engine calls,
+    fronted by deadline-budget admission control (see module docstring)."""
 
     def __init__(
         self,
         engine_fn: Callable[[], ScoreEngine],
         max_batch: int = 256,
         max_latency_ms: float = 2.0,
+        max_pending: int = 1024,
+        ewma_alpha: float = 0.2,
     ):
         if max_batch < 1:
             raise ValueError("max_batch must be >= 1")
+        if max_pending < 1:
+            raise ValueError("max_pending must be >= 1")
         self._engine_fn = engine_fn
         self.max_batch = int(max_batch)
         self.max_latency_s = float(max_latency_ms) / 1e3
+        self.max_pending = int(max_pending)
+        self._ewma_alpha = float(ewma_alpha)
         self._q: "queue.SimpleQueue" = queue.SimpleQueue()
         self._closed = threading.Event()
+        # one lock guards the admission state: pending count + service EWMA
+        self._lock = threading.Lock()
+        self._pending = 0
+        self._ewma_per_req: Optional[float] = None
         self._worker = threading.Thread(
             target=self._run, name="photon-serving-batcher", daemon=True
         )
         self._worker.start()
 
+    # -- admission state ------------------------------------------------------
+
+    def queue_stats(self) -> dict:
+        """Live admission-queue view: pending requests, the service-rate
+        EWMA (seconds per request), and the drain-time estimate a request
+        admitted right now would wait behind."""
+        with self._lock:
+            pending, ewma = self._pending, self._ewma_per_req
+        return {
+            "pending": pending,
+            "ewma_service_seconds": ewma,
+            "drain_estimate_seconds": pending * ewma if ewma else 0.0,
+        }
+
+    def _publish_queue_gauges(self, reg) -> None:
+        stats = self.queue_stats()
+        reg.gauge(
+            "photon_serving_queue_depth", "admission queue: pending requests"
+        ).set(stats["pending"])
+        reg.gauge(
+            "photon_serving_drain_estimate_seconds",
+            "admission queue: estimated drain time from the service-rate EWMA",
+        ).set(stats["drain_estimate_seconds"])
+
+    def _dec_pending(self, n: int) -> None:
+        with self._lock:
+            self._pending -= n
+
     # -- client side ---------------------------------------------------------
 
-    def submit(self, request: ScoreRequest) -> Future:
-        """Enqueue one request; the Future resolves to its float64 score."""
+    def submit(self, request: ScoreRequest, deadline_s: Optional[float] = None) -> Future:
+        """Enqueue one request; the Future resolves to its float64 score.
+
+        ``deadline_s`` is the request's latency budget in seconds from now.
+        A request that the admission controller predicts cannot meet its
+        budget (or that meets a full queue) raises :class:`ShedError`
+        immediately; one whose deadline expires while queued gets the same
+        error through its Future."""
         if self._closed.is_set():
             raise RuntimeError("MicroBatcher is closed")
-        fut: Future = Future()
         # photon: ignore[R7] — cross-thread enqueue stamp: the matching read
         # happens on the worker thread, so a span cannot bracket it
-        self._q.put((request, time.perf_counter(), fut))
+        now = time.perf_counter()
+        deadline = None if deadline_s is None else now + float(deadline_s)
+        reason = msg = None
+        with self._lock:
+            if self._pending >= self.max_pending:
+                reason, msg = "queue_full", (
+                    f"admission queue full ({self._pending} pending >= "
+                    f"max_pending={self.max_pending})"
+                )
+            elif deadline is not None:
+                # the new request drains behind everything pending plus its
+                # own service time; no EWMA yet (cold server) admits
+                drain = (self._pending + 1) * (self._ewma_per_req or 0.0)
+                if now + drain > deadline:
+                    reason, msg = "deadline", (
+                        f"cannot meet deadline budget {deadline_s * 1e3:.1f}ms: "
+                        f"estimated drain {drain * 1e3:.1f}ms behind "
+                        f"{self._pending} pending requests"
+                    )
+            if reason is None:
+                self._pending += 1
+        reg = obs.current_run().registry
+        reg.counter("photon_serving_offered_total", _OFFERED_HELP).inc()
+        if reason is not None:
+            reg.counter("photon_serving_shed_total", _SHED_HELP).labels(
+                reason=reason
+            ).inc()
+            self._publish_queue_gauges(reg)
+            raise ShedError(reason, msg)
+        fut: Future = Future()
+        self._q.put((request, now, deadline, fut))
+        self._publish_queue_gauges(reg)
         return fut
 
     def close(self, timeout: float = 5.0) -> None:
@@ -100,11 +213,43 @@ class MicroBatcher:
             batch = self._drain_batch()
             if not batch:
                 continue
+            reg = obs.current_run().registry
+            # deadline check at the last moment before scoring: requests that
+            # expired while queued are shed — a counted, typed response,
+            # never a silent drop and never a wasted engine slot
+            # photon: ignore[R7] — expiry check against the enqueue stamps
+            now = time.perf_counter()
+            live, expired = [], []
+            for item in batch:
+                _, t0, deadline, _ = item
+                (expired if deadline is not None and now > deadline else live).append(item)
+            if expired:
+                reg.counter("photon_serving_shed_total", _SHED_HELP).labels(
+                    reason="expired"
+                ).inc(len(expired))
+                for _, t0, _, fut in expired:
+                    fut.set_exception(
+                        ShedError(
+                            "expired",
+                            f"deadline expired after {(now - t0) * 1e3:.1f}ms in queue",
+                        )
+                    )
+                self._dec_pending(len(expired))
+            if not live:
+                self._publish_queue_gauges(reg)
+                continue
             # ONE engine per batch: the flip atomicity unit (see module doc)
             engine = self._engine_fn()
-            reg = obs.current_run().registry
             try:
-                scores = engine.score_requests([b[0] for b in batch])
+                # the slow-engine chaos site: PHOTON_FAULTS
+                # serving.score:delay50:... stalls here (exactly what a
+                # degraded accelerator does), serving.score:io:... raises
+                # into the counted error path below
+                faults.check("serving.score")
+                # photon: ignore[R7] — service-rate sample for the admission
+                # EWMA; paired read below, crosses the engine call
+                t_score = time.perf_counter()
+                scores = engine.score_requests([b[0] for b in live])
             except Exception as exc:
                 # the error propagates to every caller through its Future —
                 # counted, not swallowed
@@ -112,26 +257,38 @@ class MicroBatcher:
                     "photon_serving_request_errors_total",
                     "requests failed inside the score engine",
                 )
-                errors.inc(len(batch))
-                for _, _, fut in batch:
+                errors.inc(len(live))
+                for _, _, _, fut in live:
                     fut.set_exception(exc)
+                self._dec_pending(len(live))
+                self._publish_queue_gauges(reg)
                 continue
             # photon: ignore[R7] — closes the cross-thread latency interval
             # opened at submit(); feeds the latency histogram directly
             done = time.perf_counter()
+            per_req = (done - t_score) / len(live)
+            with self._lock:
+                self._ewma_per_req = (
+                    per_req
+                    if self._ewma_per_req is None
+                    else self._ewma_alpha * per_req
+                    + (1.0 - self._ewma_alpha) * self._ewma_per_req
+                )
             lat = reg.histogram(
                 "photon_serving_request_latency_seconds",
                 "request latency, enqueue to scored",
                 buckets=SERVING_LATENCY_BUCKETS,
             )
-            for i, (_, t0, fut) in enumerate(batch):
+            for i, (_, t0, _, fut) in enumerate(live):
                 fut.set_result(float(scores[i]))
                 lat.observe(done - t0)
+            self._dec_pending(len(live))
             reg.counter(
                 "photon_serving_requests_total", "requests scored"
-            ).inc(len(batch))
+            ).inc(len(live))
             reg.histogram(
                 "photon_serving_batch_size",
                 "rows per scored microbatch",
                 buckets=(1, 2, 4, 8, 16, 32, 64, 128, 256, 512, 1024),
-            ).observe(len(batch))
+            ).observe(len(live))
+            self._publish_queue_gauges(reg)

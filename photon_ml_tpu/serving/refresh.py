@@ -27,6 +27,7 @@ import threading
 from typing import Callable, Mapping, Optional
 
 from .. import obs
+from ..robust import faults
 from ..robust.atomic import atomic_write_text
 from ..robust.retry import io_call
 from .store import ModelStore, build_store, build_store_from_model
@@ -122,6 +123,10 @@ class RefreshWatcher:
 
     def _check(self) -> None:
         try:
+            # the refresh chaos site: PHOTON_FAULTS serving.refresh:delay:...
+            # stalls a flip mid-poll, serving.refresh:io:... raises into the
+            # swallow-and-retry path below while the live model keeps serving
+            faults.check("serving.refresh")
             name = current_snapshot(self.serving_root)
             if name is None or name == self._live:
                 return

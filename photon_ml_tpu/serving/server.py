@@ -6,30 +6,54 @@ reference once per microbatch, and a ``RefreshWatcher`` flip replaces it with
 a single attribute assignment — the GIL makes the swap atomic, the per-batch
 capture makes it *clean*: every batch scores entirely on one snapshot.
 
-For processes that can't link the package, ``serve_socket`` exposes the same
-surface over an AF_UNIX socket speaking JSON lines::
+Overload protection is the batcher's deadline-budget admission control
+(``serving.batcher``): requests carry a latency budget
+(``default_deadline_ms`` server-wide, or per request), the pending queue is
+bounded, and refusals are typed ``ShedError`` responses counted in
+``photon_serving_shed_total{reason=}`` — past the saturation knee the server
+sheds excess load instead of letting the queue collapse everyone's p99.
 
-    -> {"features": {"shard": [[idx...], [val...]]}, "ids": {...}, "offset": 0.0}
-    <- {"score": 1.25}   |   {"error": "..."}
+For processes that can't link the package, ``serve_socket`` exposes the same
+surface over an AF_UNIX socket (``path=``) or a TCP listener
+(``listen="host:port"``) speaking JSON lines through one shared
+connection-handler::
+
+    -> {"features": {"shard": [[idx...], [val...]]}, "ids": {...},
+        "offset": 0.0, "deadline_ms": 50}
+    <- {"score": 1.25}
+     | {"error": "...", "error_type": "shed", "reason": "deadline"}
+     | {"error": "...", "error_type": "bad_request", "kind": "not_json"}
+     | {"error": "...", "error_type": "error"}
 
 one connection per client, one request per line, responses in order.
+Malformed input never kills the connection silently: oversized lines,
+non-JSON, and bad fields each get a typed error (and a
+``photon_serving_bad_request_total{kind=}`` count); mid-line disconnects are
+counted and closed cleanly. On ``stop_event`` every open connection is shut
+down deterministically and its handler thread joined — no daemon thread
+outlives the listener holding an open socket.
 """
 
 from __future__ import annotations
 
 import json
+import numbers
 import os
 import socket
 import threading
-from typing import Optional
+from typing import Optional, Tuple, Union
 
 import jax.numpy as jnp
 
 from .. import obs
-from .batcher import MicroBatcher
+from .batcher import MicroBatcher, ShedError
 from .engine import ScoreEngine, ScoreRequest
 from .refresh import RefreshWatcher, open_current
 from .store import ModelStore
+
+# One JSON-lines request must fit one line; past this the framing cannot be
+# trusted, so the response is a typed refusal and the connection closes.
+MAX_REQUEST_LINE_BYTES = 1 << 20
 
 
 class ScoringServer:
@@ -46,6 +70,9 @@ class ScoringServer:
         serving_root: Optional[str] = None,
         max_batch: int = 256,
         max_latency_ms: float = 2.0,
+        max_pending: int = 1024,
+        default_deadline_ms: Optional[float] = None,
+        overload_shed_threshold: Optional[float] = None,
         poll_seconds: float = 0.2,
         dtype=jnp.float32,
         status_port: Optional[int] = None,
@@ -54,6 +81,9 @@ class ScoringServer:
             raise ValueError("pass exactly one of store / engine / serving_root")
         self.dtype = dtype
         self.snapshot_name: Optional[str] = None
+        self.default_deadline_s: Optional[float] = (
+            None if default_deadline_ms is None else float(default_deadline_ms) / 1e3
+        )
         self._lock = threading.Lock()
         self._watcher: Optional[RefreshWatcher] = None
         self._status_server = None
@@ -69,8 +99,18 @@ class ScoringServer:
             self._engine = engine
         self._engine.warm()
         self._batcher = MicroBatcher(
-            self._current_engine, max_batch=max_batch, max_latency_ms=max_latency_ms
+            self._current_engine,
+            max_batch=max_batch,
+            max_latency_ms=max_latency_ms,
+            max_pending=max_pending,
         )
+        if overload_shed_threshold is not None:
+            # /healthz compares the scrape-delta shed rate against this
+            # (obs/http.py): past it the replica answers 503 "overloaded"
+            # so a load balancer backs off while scoring itself continues
+            obs.current_run().status.update(
+                overload_shed_threshold=float(overload_shed_threshold)
+            )
         if status_port is not None:
             # live scrape surface (metrics otherwise only flush to files at
             # close): /metrics text exposition, /healthz, /statusz with
@@ -126,13 +166,27 @@ class ScoringServer:
 
     # -- scoring surface ------------------------------------------------------
 
-    def submit(self, request: ScoreRequest):
-        """Enqueue one request; returns a Future resolving to its score."""
-        return self._batcher.submit(request)
+    def submit(self, request: ScoreRequest, deadline_s: Optional[float] = None):
+        """Enqueue one request; returns a Future resolving to its score.
+        ``deadline_s`` overrides the server's ``default_deadline_ms`` budget
+        for this request (None = use the server default; the admission
+        controller may raise :class:`ShedError` immediately)."""
+        if deadline_s is None:
+            deadline_s = self.default_deadline_s
+        return self._batcher.submit(request, deadline_s=deadline_s)
 
-    def score(self, request: ScoreRequest, timeout: float = 30.0) -> float:
-        """Blocking single-request score."""
-        return self._batcher.submit(request).result(timeout=timeout)
+    def score(
+        self,
+        request: ScoreRequest,
+        timeout: float = 30.0,
+        deadline_s: Optional[float] = None,
+    ) -> float:
+        """Blocking single-request score (sheds surface as ShedError)."""
+        return self.submit(request, deadline_s=deadline_s).result(timeout=timeout)
+
+    def queue_stats(self) -> dict:
+        """Live admission-queue stats (pending depth + drain estimate)."""
+        return self._batcher.queue_stats()
 
     def close(self) -> None:
         if self._watcher is not None:
@@ -142,52 +196,254 @@ class ScoringServer:
         self._batcher.close()
 
 
-def _handle_conn(server: ScoringServer, conn: socket.socket) -> None:
-    with conn, conn.makefile("rwb") as f:
-        for line in f:
-            line = line.strip()
-            if not line:
-                continue
-            try:
-                msg = json.loads(line)
-                req = ScoreRequest(
-                    features={
-                        shard: (tuple(iv[0]), tuple(iv[1]))
-                        for shard, iv in msg.get("features", {}).items()
-                    },
-                    ids=msg.get("ids", {}),
-                    offset=float(msg.get("offset", 0.0)),
-                )
-                out = {"score": server.score(req)}
-            except Exception as exc:
-                obs.swallowed_error("serving.socket")
-                out = {"error": str(exc)}
-            f.write((json.dumps(out) + "\n").encode())
-            f.flush()
+# -- the socket front --------------------------------------------------------
+
+
+class BadRequestError(ValueError):
+    """A socket request the server refuses to parse; ``kind`` is the
+    ``photon_serving_bad_request_total`` label."""
+
+    def __init__(self, kind: str, message: str):
+        super().__init__(message)
+        self.kind = kind
+
+
+def _count_bad_request(kind: str) -> None:
+    obs.current_run().registry.counter(
+        "photon_serving_bad_request_total",
+        "malformed socket requests refused with a typed error",
+    ).labels(kind=kind).inc()
+
+
+def _parse_score_request(msg) -> Tuple[ScoreRequest, Optional[float]]:
+    """Validate one decoded JSON request; raises BadRequestError('bad_fields')
+    on anything the engine should never see. Returns (request, deadline_s)."""
+    if not isinstance(msg, dict):
+        raise BadRequestError(
+            "bad_fields", f"request must be a JSON object, got {type(msg).__name__}"
+        )
+    if "features" not in msg:
+        raise BadRequestError("bad_fields", "missing required field 'features'")
+    features = msg["features"]
+    if not isinstance(features, dict):
+        raise BadRequestError(
+            "bad_fields",
+            f"'features' must map shard -> [[idx...], [val...]], "
+            f"got {type(features).__name__}",
+        )
+    parsed = {}
+    for shard, iv in features.items():
+        if (
+            not isinstance(iv, (list, tuple))
+            or len(iv) != 2
+            or not all(isinstance(x, (list, tuple)) for x in iv)
+            or len(iv[0]) != len(iv[1])
+        ):
+            raise BadRequestError(
+                "bad_fields",
+                f"features[{shard!r}] must be two equal-length lists "
+                "[[idx...], [val...]]",
+            )
+        idx, val = iv
+        if not all(isinstance(i, int) and not isinstance(i, bool) and i >= 0 for i in idx):
+            raise BadRequestError(
+                "bad_fields", f"features[{shard!r}] indices must be ints >= 0"
+            )
+        if not all(
+            isinstance(v, numbers.Real) and not isinstance(v, bool) for v in val
+        ):
+            raise BadRequestError(
+                "bad_fields", f"features[{shard!r}] values must be numbers"
+            )
+        parsed[shard] = (tuple(int(i) for i in idx), tuple(float(v) for v in val))
+    ids = msg.get("ids", {})
+    if not isinstance(ids, dict):
+        raise BadRequestError("bad_fields", "'ids' must be a JSON object")
+    offset = msg.get("offset", 0.0)
+    if not isinstance(offset, numbers.Real) or isinstance(offset, bool):
+        raise BadRequestError("bad_fields", "'offset' must be a number")
+    deadline_ms = msg.get("deadline_ms")
+    deadline_s: Optional[float] = None
+    if deadline_ms is not None:
+        if not isinstance(deadline_ms, numbers.Real) or isinstance(deadline_ms, bool):
+            raise BadRequestError("bad_fields", "'deadline_ms' must be a number")
+        if float(deadline_ms) <= 0:
+            raise BadRequestError("bad_fields", "'deadline_ms' must be > 0")
+        deadline_s = float(deadline_ms) / 1e3
+    return ScoreRequest(features=parsed, ids=ids, offset=float(offset)), deadline_s
+
+
+def _handle_conn(server: ScoringServer, conn: socket.socket, conns, conns_lock) -> None:
+    """One JSON-lines connection: the shared handler behind both the AF_UNIX
+    and the TCP listener. Registered in ``conns`` so the listener can shut
+    the connection down deterministically at stop time."""
+    try:
+        with conn, conn.makefile("rwb") as f:
+
+            def respond(doc: dict) -> bool:
+                try:
+                    f.write((json.dumps(doc) + "\n").encode())
+                    f.flush()
+                    return True
+                except (OSError, ValueError):
+                    return False  # peer (or the stop path) tore the socket down
+
+            while True:
+                try:
+                    line = f.readline(MAX_REQUEST_LINE_BYTES + 1)
+                except (OSError, ValueError):
+                    break  # shutdown() from the stop path, or peer reset
+                if not line:
+                    break  # clean EOF
+                if len(line) > MAX_REQUEST_LINE_BYTES:
+                    # framing is unrecoverable past the cap: typed refusal,
+                    # then a deterministic close
+                    _count_bad_request("oversized")
+                    respond(
+                        {
+                            "error": (
+                                "request line exceeds "
+                                f"{MAX_REQUEST_LINE_BYTES} bytes"
+                            ),
+                            "error_type": "bad_request",
+                            "kind": "oversized",
+                        }
+                    )
+                    break
+                if not line.endswith(b"\n"):
+                    # mid-line disconnect: nothing to respond to, close clean
+                    _count_bad_request("disconnect")
+                    break
+                line = line.strip()
+                if not line:
+                    continue
+                try:
+                    msg = json.loads(line)
+                except json.JSONDecodeError as exc:
+                    _count_bad_request("not_json")
+                    if not respond(
+                        {
+                            "error": f"request is not valid JSON: {exc}",
+                            "error_type": "bad_request",
+                            "kind": "not_json",
+                        }
+                    ):
+                        break
+                    continue
+                try:
+                    req, deadline_s = _parse_score_request(msg)
+                except BadRequestError as exc:
+                    _count_bad_request(exc.kind)
+                    if not respond(
+                        {
+                            "error": str(exc),
+                            "error_type": "bad_request",
+                            "kind": exc.kind,
+                        }
+                    ):
+                        break
+                    continue
+                try:
+                    out = {"score": server.score(req, deadline_s=deadline_s)}
+                except ShedError as exc:
+                    # admission refusal: a typed response, never a dropped
+                    # connection — the client can back off and retry
+                    out = {
+                        "error": str(exc),
+                        "error_type": "shed",
+                        "reason": exc.reason,
+                    }
+                except Exception as exc:
+                    obs.swallowed_error("serving.socket")
+                    out = {"error": str(exc), "error_type": "error"}
+                if not respond(out):
+                    break
+    finally:
+        with conns_lock:
+            conns.discard(conn)
+
+
+def _parse_listen(listen: Union[str, Tuple[str, int]]) -> Tuple[str, int]:
+    if isinstance(listen, (tuple, list)) and len(listen) == 2:
+        return str(listen[0]), int(listen[1])
+    host, sep, port = str(listen).rpartition(":")
+    if not sep or not host:
+        raise ValueError(
+            f"--listen address must be host:port, got {listen!r}"
+        )
+    return host, int(port)
 
 
 def serve_socket(
     server: ScoringServer,
-    path: str,
+    path: Optional[str] = None,
     stop_event: Optional[threading.Event] = None,
+    listen: Optional[Union[str, Tuple[str, int]]] = None,
+    on_bound=None,
 ) -> None:
-    """Serve ``server`` over an AF_UNIX socket at ``path`` until
-    ``stop_event`` is set (runs forever without one). One thread per
-    connection; requests within a connection are answered in order."""
-    if os.path.exists(path):
-        os.unlink(path)
+    """Serve ``server`` over exactly one of an AF_UNIX socket at ``path`` or
+    a TCP listener at ``listen`` ("host:port" or (host, port); port 0 binds
+    ephemeral) until ``stop_event`` is set (runs forever without one). One
+    thread per connection through the shared JSON-lines handler;
+    ``on_bound`` (if given) is called once with the bound address — the
+    socket path, or the (host, port) tuple with the resolved port.
+
+    Shutdown is deterministic: when ``stop_event`` fires, every open
+    connection is shut down (interrupting blocked reads) and every handler
+    thread joined before this function returns — no daemon thread survives
+    holding an open socket."""
+    if (path is None) == (listen is None):
+        raise ValueError(
+            "serve_socket needs exactly one of path (AF_UNIX) / listen (TCP "
+            "host:port)"
+        )
     stop = stop_event or threading.Event()
-    with socket.socket(socket.AF_UNIX, socket.SOCK_STREAM) as sock:
+    if path is not None:
+        if os.path.exists(path):
+            os.unlink(path)
+        sock = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
         sock.bind(path)
-        sock.listen()
-        sock.settimeout(0.2)
-        while not stop.is_set():
+        bound: object = path
+    else:
+        host, port = _parse_listen(listen)
+        sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        sock.bind((host, port))
+        bound = sock.getsockname()[:2]
+    conns: set = set()
+    conns_lock = threading.Lock()
+    threads = []
+    try:
+        with sock:
+            sock.listen()
+            sock.settimeout(0.2)
+            if on_bound is not None:
+                on_bound(bound)
+            while not stop.is_set():
+                try:
+                    conn, _ = sock.accept()
+                except socket.timeout:
+                    continue
+                with conns_lock:
+                    conns.add(conn)
+                t = threading.Thread(
+                    target=_handle_conn,
+                    args=(server, conn, conns, conns_lock),
+                    daemon=True,
+                )
+                threads.append(t)
+                t.start()
+                if len(threads) > 64:
+                    threads = [x for x in threads if x.is_alive()]
+    finally:
+        with conns_lock:
+            live = list(conns)
+        for c in live:
             try:
-                conn, _ = sock.accept()
-            except socket.timeout:
-                continue
-            threading.Thread(
-                target=_handle_conn, args=(server, conn), daemon=True
-            ).start()
-    if os.path.exists(path):
-        os.unlink(path)
+                c.shutdown(socket.SHUT_RDWR)
+            except OSError:
+                pass  # already closed by its handler
+        for t in threads:
+            t.join(timeout=5.0)
+        if path is not None and os.path.exists(path):
+            os.unlink(path)

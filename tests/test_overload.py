@@ -1,0 +1,762 @@
+"""Overload-proof serving: coordinated-omission accounting, deadline-budget
+admission control, hostile socket input, the TCP front, /healthz overload
+signaling, and the slow-engine chaos drill.
+
+The open-loop math tests pin WHY the load harness measures from intended
+send time: the same FIFO server, measured closed-loop, hides a stall's
+queueing delay inside think time (one bad sample), while the open-loop
+accounting charges the backlog to every request scheduled during it.
+
+The admission tests pin the shed contract: every refusal is a typed
+:class:`ShedError` with a reason (``queue_full`` / ``deadline`` /
+``expired``), every refusal is counted in
+``photon_serving_shed_total{reason=}``, and no request is ever dropped
+without a response — the invariant the chaos drill then holds under a
+PHOTON_FAULTS slow-engine storm with a live snapshot flip in the middle.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import socket
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from photon_ml_tpu import obs, serving
+from photon_ml_tpu.robust import faults
+from photon_ml_tpu.serving import loadgen
+from photon_ml_tpu.serving.batcher import MicroBatcher, ShedError
+
+
+@pytest.fixture
+def run_telemetry():
+    run = obs.RunTelemetry()
+    with obs.use_run(run):
+        yield run
+
+
+@pytest.fixture(autouse=True)
+def _clear_faults():
+    yield
+    faults.clear()
+
+
+def counter_total(run, name, **labels):
+    total = 0.0
+    for m in run.registry.snapshot():
+        if m["name"] == name and m["kind"] == "counter":
+            got = m.get("labels", {})
+            if all(got.get(k) == v for k, v in labels.items()):
+                total += m["value"]
+    return total
+
+
+class FakeEngine:
+    """Jax-free stand-in for ScoreEngine: score = sum of feature values +
+    offset, with optional per-batch delay/error/blocking hooks."""
+
+    def __init__(self, delay_s=0.0, fail=False, block_on=None):
+        self.delay_s = delay_s
+        self.fail = fail
+        self.block_on = block_on  # threading.Event the batch waits for
+        self.scored = []
+
+    def warm(self):
+        pass
+
+    def score_requests(self, requests):
+        if self.block_on is not None:
+            assert self.block_on.wait(10.0)
+        if self.delay_s:
+            time.sleep(self.delay_s)
+        if self.fail:
+            raise ValueError("injected engine failure")
+        self.scored.extend(requests)
+        return [
+            float(
+                sum(v for _, vals in r.features.items() for v in vals[1])
+                + r.offset
+            )
+            for r in requests
+        ]
+
+
+def make_request(value=1.0, offset=0.0, deadline_ms=None):
+    doc = {
+        "features": {"globalShard": [[0], [value]]},
+        "offset": offset,
+    }
+    if deadline_ms is not None:
+        doc["deadline_ms"] = deadline_ms
+    return doc
+
+
+def score_request(value=1.0, offset=0.0):
+    return serving.ScoreRequest(
+        features={"globalShard": ((0,), (value,))}, offset=offset
+    )
+
+
+# -- coordinated-omission math ------------------------------------------------
+
+
+def test_poisson_schedule_deterministic_and_calibrated():
+    a = loadgen.poisson_intended_times(500.0, 4.0, seed=7)
+    b = loadgen.poisson_intended_times(500.0, 4.0, seed=7)
+    np.testing.assert_array_equal(a, b)
+    c = loadgen.poisson_intended_times(500.0, 4.0, seed=8)
+    assert not np.array_equal(a, c)
+    assert a[0] >= 0 and a[-1] <= 4.0
+    assert np.all(np.diff(a) >= 0)
+    # ~500 qps x 4s = ~2000 arrivals; Poisson sd ~45, allow 5 sigma
+    assert 1750 <= len(a) <= 2250
+    with pytest.raises(ValueError):
+        loadgen.poisson_intended_times(0.0, 1.0)
+    with pytest.raises(ValueError):
+        loadgen.poisson_intended_times(10.0, -1.0)
+
+
+def test_open_loop_reports_queueing_a_closed_loop_hides():
+    """One 1-second stall on a server drained every 10ms: the closed-loop
+    client records ONE bad sample (its other latencies are pure service
+    time), while the open-loop accounting charges the backlog to every
+    request scheduled during the stall — the true experienced latency."""
+    n = 101
+    intended = [0.01 * k for k in range(n)]  # 100 qps, 1s worth
+    service = [1.0] + [0.001] * (n - 1)  # first request stalls the server
+    open_lat = loadgen.simulate_fifo_open_loop(intended, service)
+    closed_lat = loadgen.simulate_fifo_closed_loop(service)
+    # closed loop: only the stalled request itself looks slow
+    assert np.median(closed_lat) == 0.001
+    assert sum(l > 0.1 for l in closed_lat) == 1
+    # open loop: most of the schedule queued behind the stall
+    assert np.median(open_lat) > 0.25
+    assert sum(l > 0.1 for l in open_lat) > n // 2
+    # identical servers, identical work — the measurements differ only in
+    # what they charge the queue to
+    assert sum(open_lat) > 10 * sum(closed_lat)
+
+
+def test_open_loop_matches_closed_loop_when_server_keeps_up():
+    intended = [0.01 * k for k in range(50)]
+    service = [0.002] * 50  # server always free by the next arrival
+    np.testing.assert_allclose(
+        loadgen.simulate_fifo_open_loop(intended, service),
+        loadgen.simulate_fifo_closed_loop(service),
+        atol=1e-12,
+    )
+
+
+def test_find_knee_highest_served_step():
+    def step(offered, served):
+        return loadgen.OpenLoopResult(
+            offered_qps=offered, duration_s=1.0, sent=int(offered),
+            completed=int(served), shed_admission={}, shed_expired=0,
+            errors=0, served_qps=served, achieved_offered_qps=offered,
+            latency_mean_s=0.0, latency_p50_s=0.0, latency_p99_s=0.0,
+        )
+
+    steps = [step(100, 99), step(200, 195), step(400, 250), step(800, 260)]
+    assert loadgen.find_knee(steps).offered_qps == 200
+    assert loadgen.find_knee([step(400, 250)]) is None
+
+
+# -- deadline / shed semantics -----------------------------------------------
+
+
+def test_queue_full_sheds_with_counted_refusal(run_telemetry):
+    gate = threading.Event()
+    eng = FakeEngine(block_on=gate)
+    b = MicroBatcher(lambda: eng, max_batch=1, max_latency_ms=0.1, max_pending=2)
+    try:
+        admitted = [b.submit(score_request()) for _ in range(2)]
+        with pytest.raises(ShedError) as exc:
+            b.submit(score_request())
+        assert exc.value.reason == "queue_full"
+        gate.set()
+        for f in admitted:
+            assert isinstance(f.result(timeout=10.0), float)
+        assert counter_total(
+            run_telemetry, "photon_serving_shed_total", reason="queue_full"
+        ) == 1
+        # offered counts admitted AND shed
+        assert counter_total(run_telemetry, "photon_serving_offered_total") == 3
+    finally:
+        gate.set()
+        b.close()
+
+
+def test_deadline_admission_sheds_from_ewma(run_telemetry):
+    eng = FakeEngine(delay_s=0.2)
+    b = MicroBatcher(lambda: eng, max_batch=4, max_latency_ms=0.1)
+    try:
+        # prime the service-rate EWMA with one genuinely slow batch
+        b.submit(score_request()).result(timeout=10.0)
+        assert b.queue_stats()["ewma_service_seconds"] > 0.1
+        with pytest.raises(ShedError) as exc:
+            b.submit(score_request(), deadline_s=0.01)
+        assert exc.value.reason == "deadline"
+        assert "deadline budget" in str(exc.value)
+        assert counter_total(
+            run_telemetry, "photon_serving_shed_total", reason="deadline"
+        ) == 1
+        # a request with headroom is still admitted
+        assert isinstance(
+            b.submit(score_request(), deadline_s=5.0).result(timeout=10.0),
+            float,
+        )
+    finally:
+        b.close()
+
+
+def test_expired_in_queue_shed_before_scoring_never_dropped(run_telemetry):
+    gate = threading.Event()
+    eng = FakeEngine(block_on=gate)
+    b = MicroBatcher(lambda: eng, max_batch=1, max_latency_ms=0.1)
+    try:
+        blocker = b.submit(score_request())  # occupies the engine
+        time.sleep(0.05)  # let the worker pick it up into its own batch
+        doomed = b.submit(score_request(offset=42.0), deadline_s=0.02)
+        time.sleep(0.05)  # deadline passes while queued
+        gate.set()
+        assert isinstance(blocker.result(timeout=10.0), float)
+        with pytest.raises(ShedError) as exc:
+            doomed.result(timeout=10.0)
+        assert exc.value.reason == "expired"
+        assert counter_total(
+            run_telemetry, "photon_serving_shed_total", reason="expired"
+        ) == 1
+        # shed BEFORE scoring: the engine never saw the expired request
+        assert all(r.offset != 42.0 for r in eng.scored)
+    finally:
+        gate.set()
+        b.close()
+
+
+def test_engine_failure_propagates_counted_not_shed(run_telemetry):
+    eng = FakeEngine(fail=True)
+    b = MicroBatcher(lambda: eng, max_batch=4, max_latency_ms=0.5)
+    try:
+        futs = [b.submit(score_request()) for _ in range(2)]
+        for f in futs:
+            with pytest.raises(ValueError, match="injected engine failure"):
+                f.result(timeout=10.0)
+        assert counter_total(
+            run_telemetry, "photon_serving_request_errors_total"
+        ) == 2
+        assert counter_total(run_telemetry, "photon_serving_shed_total") == 0
+    finally:
+        b.close()
+
+
+def test_queue_gauges_track_admission_state(run_telemetry):
+    eng = FakeEngine(delay_s=0.05)
+    b = MicroBatcher(lambda: eng, max_batch=8, max_latency_ms=0.5)
+    try:
+        b.submit(score_request()).result(timeout=10.0)
+        stats = b.queue_stats()
+        assert stats["pending"] == 0
+        assert stats["ewma_service_seconds"] > 0.01
+        snap = {
+            m["name"]: m["value"]
+            for m in run_telemetry.registry.snapshot()
+            if m["kind"] == "gauge"
+        }
+        assert snap["photon_serving_queue_depth"] == 0
+        assert "photon_serving_drain_estimate_seconds" in snap
+    finally:
+        b.close()
+
+
+def test_open_loop_against_live_batcher_accounts_every_request(run_telemetry):
+    eng = FakeEngine(delay_s=0.002)
+    b = MicroBatcher(lambda: eng, max_batch=64, max_latency_ms=1.0, max_pending=16)
+    try:
+        res = loadgen.run_open_loop(
+            b.submit,
+            [score_request()],
+            offered_qps=300.0,
+            duration_s=0.5,
+            seed=3,
+            deadline_s=0.05,
+        )
+        assert res.sent > 0
+        assert res.sent == res.completed + res.shed_total + res.errors
+        counted = counter_total(run_telemetry, "photon_serving_offered_total")
+        assert counted == res.sent
+        assert counter_total(run_telemetry, "photon_serving_shed_total") == (
+            res.shed_total
+        )
+        # the bounded-p99 guarantee for ADMITTED requests: queue wait fits
+        # the deadline budget, so intended-send-time p99 stays within the
+        # budget plus a service/scheduling margin
+        assert res.latency_p99_s <= 0.15
+    finally:
+        b.close()
+
+
+# -- the socket front: TCP + hostile input -----------------------------------
+
+
+@pytest.fixture
+def tcp_server(run_telemetry):
+    """ScoringServer over a fake engine on an ephemeral TCP port."""
+    server = serving.ScoringServer(engine=FakeEngine(), max_latency_ms=0.5)
+    stop = threading.Event()
+    bound = {}
+    ready = threading.Event()
+
+    def on_bound(addr):
+        bound["addr"] = addr
+        ready.set()
+
+    t = threading.Thread(
+        target=serving.serve_socket,
+        kwargs=dict(
+            server=server, listen="127.0.0.1:0", stop_event=stop,
+            on_bound=on_bound,
+        ),
+    )
+    t.start()
+    assert ready.wait(10.0)
+    yield server, bound["addr"], run_telemetry
+    stop.set()
+    t.join(timeout=10.0)
+    assert not t.is_alive(), "serve_socket leaked its listener thread"
+    server.close()
+
+
+def _connect(addr):
+    conn = socket.create_connection(tuple(addr))
+    return conn, conn.makefile("rwb")
+
+
+def _roundtrip(f, doc):
+    f.write((json.dumps(doc) + "\n").encode())
+    f.flush()
+    return json.loads(f.readline())
+
+
+def test_tcp_roundtrip_shared_handler(tcp_server):
+    _, addr, _ = tcp_server
+    conn, f = _connect(addr)
+    try:
+        out = _roundtrip(f, make_request(value=2.5, offset=1.0))
+        assert out == {"score": 3.5}
+        # deadline_ms rides along per request
+        out = _roundtrip(f, make_request(value=1.0, deadline_ms=5000))
+        assert out == {"score": 1.0}
+    finally:
+        conn.close()
+
+
+def test_hostile_inputs_typed_errors_and_counters(tcp_server):
+    _, addr, run = tcp_server
+    conn, f = _connect(addr)
+    try:
+        cases = [
+            (b"this is not json\n", "not_json"),
+            (b"[1, 2, 3]\n", "bad_fields"),  # JSON, but not an object
+            (b'{"ids": {}}\n', "bad_fields"),  # missing features
+            (b'{"features": "nonsense"}\n', "bad_fields"),
+            (b'{"features": {"g": [[0], [1.0, 2.0]]}}\n', "bad_fields"),
+            (b'{"features": {"g": [[-1], [1.0]]}}\n', "bad_fields"),
+            (b'{"features": {"g": [[0], ["x"]]}}\n', "bad_fields"),
+            (b'{"features": {"g": [[0], [1.0]]}, "deadline_ms": 0}\n',
+             "bad_fields"),
+            (b'{"features": {"g": [[0], [1.0]]}, "offset": "z"}\n',
+             "bad_fields"),
+        ]
+        for line, kind in cases:
+            f.write(line)
+            f.flush()
+            out = json.loads(f.readline())
+            assert out["error_type"] == "bad_request", (line, out)
+            assert out["kind"] == kind, (line, out)
+        # the connection survived every malformed line
+        assert _roundtrip(f, make_request(value=1.5)) == {"score": 1.5}
+        assert counter_total(
+            run, "photon_serving_bad_request_total", kind="not_json"
+        ) == 1
+        assert counter_total(
+            run, "photon_serving_bad_request_total", kind="bad_fields"
+        ) == 8
+    finally:
+        conn.close()
+
+
+def test_oversized_line_refused_and_connection_closed(tcp_server):
+    _, addr, run = tcp_server
+    conn, f = _connect(addr)
+    try:
+        pad = b"x" * (serving.MAX_REQUEST_LINE_BYTES + 16)
+        f.write(b'{"pad": "' + pad + b'"}\n')
+        f.flush()
+        out = json.loads(f.readline())
+        assert out["error_type"] == "bad_request" and out["kind"] == "oversized"
+        # framing is unrecoverable: the server closes after responding
+        assert f.readline() == b""
+        assert counter_total(
+            run, "photon_serving_bad_request_total", kind="oversized"
+        ) == 1
+    finally:
+        conn.close()
+
+
+def test_mid_line_disconnect_counted_clean_close(tcp_server):
+    _, addr, run = tcp_server
+    conn = socket.create_connection(tuple(addr))
+    conn.sendall(b'{"features": {"g": ')  # no newline, then vanish
+    conn.close()
+    deadline = time.time() + 5.0
+    while time.time() < deadline:
+        if counter_total(
+            run, "photon_serving_bad_request_total", kind="disconnect"
+        ):
+            break
+        time.sleep(0.01)
+    assert counter_total(
+        run, "photon_serving_bad_request_total", kind="disconnect"
+    ) == 1
+
+
+def test_shed_surfaces_as_typed_socket_error(run_telemetry):
+    eng = FakeEngine(delay_s=0.2)
+    server = serving.ScoringServer(
+        engine=eng, max_batch=4, max_latency_ms=0.1
+    )
+    stop = threading.Event()
+    bound = {}
+    ready = threading.Event()
+    t = threading.Thread(
+        target=serving.serve_socket,
+        kwargs=dict(
+            server=server, listen="127.0.0.1:0", stop_event=stop,
+            on_bound=lambda a: (bound.update(addr=a), ready.set()),
+        ),
+    )
+    t.start()
+    assert ready.wait(10.0)
+    try:
+        conn, f = _connect(bound["addr"])
+        try:
+            # prime the EWMA so the admission estimate knows batches are slow
+            assert "score" in _roundtrip(f, make_request())
+            out = _roundtrip(f, make_request(deadline_ms=1))
+            assert out["error_type"] == "shed"
+            assert out["reason"] == "deadline"
+            assert counter_total(
+                run_telemetry, "photon_serving_shed_total", reason="deadline"
+            ) == 1
+            # connection still serves admitted requests
+            assert "score" in _roundtrip(f, make_request(deadline_ms=60_000))
+        finally:
+            conn.close()
+    finally:
+        stop.set()
+        t.join(timeout=10.0)
+        server.close()
+
+
+def test_af_unix_front_unchanged(run_telemetry, tmp_path):
+    server = serving.ScoringServer(engine=FakeEngine(), max_latency_ms=0.5)
+    stop = threading.Event()
+    sock_path = str(tmp_path / "serve.sock")
+    ready = threading.Event()
+    t = threading.Thread(
+        target=serving.serve_socket,
+        kwargs=dict(
+            server=server, path=sock_path, stop_event=stop,
+            on_bound=lambda a: ready.set(),
+        ),
+    )
+    t.start()
+    assert ready.wait(10.0)
+    try:
+        c = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
+        c.connect(sock_path)
+        f = c.makefile("rwb")
+        assert _roundtrip(f, make_request(value=2.0)) == {"score": 2.0}
+        c.close()
+    finally:
+        stop.set()
+        t.join(timeout=10.0)
+        server.close()
+    assert not os.path.exists(sock_path)
+
+
+def test_serve_socket_needs_exactly_one_front(run_telemetry, tmp_path):
+    server = serving.ScoringServer(engine=FakeEngine())
+    try:
+        with pytest.raises(ValueError, match="exactly one of path"):
+            serving.serve_socket(server)
+        with pytest.raises(ValueError, match="exactly one of path"):
+            serving.serve_socket(
+                server, path=str(tmp_path / "s.sock"), listen="127.0.0.1:0"
+            )
+    finally:
+        server.close()
+
+
+def test_stop_event_closes_open_connections_deterministically(tcp_server):
+    _, addr, _ = tcp_server
+    # a connection sitting in a blocked read must be shut down at stop time
+    conn = socket.create_connection(tuple(addr))
+    try:
+        before = threading.active_count()
+        # the fixture teardown sets stop + joins; this test just proves the
+        # blocked connection doesn't survive it
+        time.sleep(0.05)
+        assert threading.active_count() >= before  # handler thread is live
+    finally:
+        conn.close()
+
+
+# -- /healthz overload + /statusz admission ----------------------------------
+
+
+def test_healthz_overloaded_while_shed_rate_exceeds_threshold():
+    import urllib.error
+    import urllib.request
+
+    run = obs.RunTelemetry()
+    run.status.update(overload_shed_threshold=5.0)
+    shed = run.registry.counter(
+        "photon_serving_shed_total", "test"
+    ).labels(reason="deadline")
+    srv = obs.IntrospectionServer(run, port=0)
+    try:
+        base = f"http://127.0.0.1:{srv.port}"
+
+        def get(path):
+            with urllib.request.urlopen(base + path, timeout=5) as r:
+                return r.status, json.loads(r.read())
+
+        assert get("/healthz") == (200, {"status": "ok"})  # first sample
+        shed.inc(1000)  # a storm between scrapes
+        with pytest.raises(urllib.error.HTTPError) as e:
+            get("/healthz")
+        assert e.value.code == 503
+        assert json.loads(e.value.read()) == {"status": "overloaded"}
+        time.sleep(0.05)  # no new sheds: rate decays below threshold
+        assert get("/healthz") == (200, {"status": "ok"})
+    finally:
+        srv.stop()
+
+
+def test_statusz_offered_served_shed_and_admission():
+    run = obs.RunTelemetry()
+    reg = run.registry
+    reg.counter("photon_serving_requests_total", "t").inc(90)
+    reg.counter("photon_serving_offered_total", "t").inc(100)
+    reg.counter("photon_serving_shed_total", "t").labels(reason="deadline").inc(7)
+    reg.counter("photon_serving_shed_total", "t").labels(reason="queue_full").inc(3)
+    reg.counter("photon_serving_bad_request_total", "t").labels(kind="not_json").inc(2)
+    reg.gauge("photon_serving_queue_depth", "t").set(4)
+    reg.gauge("photon_serving_drain_estimate_seconds", "t").set(0.25)
+    srv = obs.IntrospectionServer(run, port=0)
+    try:
+        doc = srv.statusz()
+        s = doc["serving"]
+        assert s["requests_total"] == 90
+        assert s["offered_total"] == 100
+        assert s["shed_total"] == 10
+        assert s["shed_by_reason"] == {"deadline": 7, "queue_full": 3}
+        assert s["bad_requests"] == {"not_json": 2}
+        assert s["admission"] == {
+            "queue_depth": 4,
+            "drain_estimate_seconds": 0.25,
+        }
+        assert "qps" not in s  # first scrape: no delta yet
+        reg.counter("photon_serving_requests_total", "t").inc(10)
+        reg.counter("photon_serving_offered_total", "t").inc(20)
+        reg.counter("photon_serving_shed_total", "t").labels(
+            reason="deadline"
+        ).inc(10)
+        time.sleep(0.05)
+        s2 = srv.statusz()["serving"]
+        assert s2["qps"] > 0
+        assert s2["offered_qps"] > s2["qps"]
+        assert s2["shed_qps"] > 0
+    finally:
+        srv.stop()
+
+
+# -- fault sites + the chaos drill -------------------------------------------
+
+
+def test_serving_score_delay_site_stalls_batch(run_telemetry):
+    faults.configure("serving.score:delay120:1")
+    eng = FakeEngine()
+    b = MicroBatcher(lambda: eng, max_batch=4, max_latency_ms=0.1)
+    try:
+        t0 = time.perf_counter()
+        b.submit(score_request()).result(timeout=10.0)
+        assert time.perf_counter() - t0 >= 0.12
+        assert counter_total(
+            run_telemetry, "photon_faults_injected_total", site="serving.score"
+        ) == 1
+        # second batch: spec exhausted, fast again
+        t0 = time.perf_counter()
+        b.submit(score_request()).result(timeout=10.0)
+        assert time.perf_counter() - t0 < 0.1
+    finally:
+        b.close()
+
+
+def test_serving_score_io_site_is_counted_engine_error(run_telemetry):
+    faults.configure("serving.score:io:1")
+    eng = FakeEngine()
+    b = MicroBatcher(lambda: eng, max_batch=4, max_latency_ms=0.1)
+    try:
+        with pytest.raises(faults.InjectedIOError):
+            b.submit(score_request()).result(timeout=10.0)
+        assert counter_total(
+            run_telemetry, "photon_serving_request_errors_total"
+        ) == 1
+        # the engine never saw the batch; the next one scores clean
+        assert eng.scored == []
+        assert isinstance(b.submit(score_request()).result(timeout=10.0), float)
+    finally:
+        b.close()
+
+
+# the chaos drill proper needs the real jax engine + snapshot publication
+D_FIXED = 6
+
+
+def _make_game_model(fe_shift=0.0, seed=0):
+    import jax.numpy as jnp
+
+    from photon_ml_tpu.models.game import FixedEffectModel, GameModel
+    from photon_ml_tpu.models.glm import Coefficients, LogisticRegressionModel
+
+    rng = np.random.default_rng(seed)
+    fe = FixedEffectModel(
+        model=LogisticRegressionModel(
+            Coefficients(jnp.asarray(rng.standard_normal(D_FIXED) + fe_shift))
+        ),
+        feature_shard="globalShard",
+    )
+    return GameModel(models={"global": fe}, task="logistic_regression")
+
+
+def _drill_request(rng):
+    idx = np.sort(rng.choice(D_FIXED, size=4, replace=False))
+    return serving.ScoreRequest(
+        features={
+            "globalShard": (
+                tuple(int(i) for i in idx),
+                tuple(rng.standard_normal(4).tolist()),
+            )
+        },
+        offset=float(rng.standard_normal()),
+    )
+
+
+def test_chaos_drill_slow_engine_storm_with_live_flip(run_telemetry, tmp_path):
+    """The acceptance drill: a seeded serving.score delay storm under live
+    open-loop load with a snapshot publish + flip mid-storm. Invariants:
+    zero requests without a response, every refusal counted, the flip lands
+    cleanly, and the server scores correctly afterwards."""
+    root = str(tmp_path / "serving")
+    serving.publish_snapshot(root, "v1", game_model=_make_game_model(0.0))
+    server = serving.ScoringServer(
+        serving_root=root, max_batch=32, max_latency_ms=1.0, max_pending=64
+    )
+    try:
+        rng = np.random.default_rng(0)
+        requests = [_drill_request(rng) for _ in range(64)]
+        server.submit(requests[0], deadline_s=60.0).result(timeout=60.0)
+
+        # every other batch stalls 80ms: a degraded accelerator, not a
+        # broken one — admission must shed what can't make its deadline
+        faults.configure("serving.score:delay80:p0.5", seed=4)
+        flip_err = []
+
+        def flip_mid_storm():
+            try:
+                time.sleep(0.4)
+                serving.publish_snapshot(
+                    root, "v2", game_model=_make_game_model(2.0)
+                )
+                server.poke_refresh()
+            except Exception as exc:  # pragma: no cover - surfaced below
+                flip_err.append(exc)
+
+        flipper = threading.Thread(target=flip_mid_storm)
+        flipper.start()
+        res = loadgen.run_open_loop(
+            server.submit,
+            requests,
+            offered_qps=120.0,
+            duration_s=1.5,
+            seed=11,
+            deadline_s=0.06,
+        )
+        flipper.join(timeout=30.0)
+        faults.clear()
+
+        assert not flip_err, flip_err
+        # no request lost without a response
+        assert res.sent == res.completed + res.shed_total + res.errors
+        assert res.errors == 0  # delays degrade, they don't break
+        # the storm actually fired and the controller actually shed
+        assert counter_total(
+            run_telemetry, "photon_faults_injected_total", site="serving.score"
+        ) > 0
+        assert res.shed_total > 0
+        assert counter_total(
+            run_telemetry, "photon_serving_shed_total"
+        ) >= res.shed_total
+        # the flip landed cleanly mid-storm
+        assert server.snapshot_name == "v2"
+        assert counter_total(
+            run_telemetry, "photon_serving_refresh_total"
+        ) == 1
+        # and the post-storm server scores on the NEW coefficients
+        req = requests[0]
+        got = server.score(req, deadline_s=None)
+        model = _make_game_model(2.0)
+        w = np.asarray(model.models["global"].model.coefficients.means)
+        gi, gv = req.features["globalShard"]
+        want = req.offset + float(np.dot(w[np.asarray(gi)], np.asarray(gv)))
+        assert got == pytest.approx(want, rel=1e-5)
+    finally:
+        faults.clear()
+        server.close()
+
+
+def test_serving_refresh_fault_swallowed_then_recovers(run_telemetry, tmp_path):
+    root = str(tmp_path / "serving")
+    serving.publish_snapshot(root, "v1", game_model=_make_game_model(0.0))
+    server = serving.ScoringServer(serving_root=root, max_latency_ms=0.5)
+    try:
+        serving.publish_snapshot(root, "v2", game_model=_make_game_model(1.0))
+        faults.configure("serving.refresh:io:1")
+        server.poke_refresh()  # injected IO error: swallowed, still on v1
+        assert server.snapshot_name == "v1"
+        assert counter_total(
+            run_telemetry,
+            "photon_swallowed_errors_total",
+        ) >= 1
+        server.poke_refresh()  # spec exhausted: the retry flips
+        assert server.snapshot_name == "v2"
+    finally:
+        server.close()
+
+
+def test_faults_delay_grammar():
+    (spec,) = faults.parse_faults("serving.score:delay:1")
+    assert spec.kind == "delay" and spec.delay_s == pytest.approx(0.05)
+    (spec,) = faults.parse_faults("serving.score:delay250:2x3")
+    assert spec.kind == "delay" and spec.delay_s == pytest.approx(0.25)
+    assert spec.at == 2 and spec.times == 3
+    with pytest.raises(ValueError):
+        faults.parse_faults("site:delayXX:1")
+    with pytest.raises(ValueError, match="io|kill|nan|delay"):
+        faults.parse_faults("site:bogus:1")

@@ -175,6 +175,12 @@ def _trigger_disk_slice_bad_layout(raw, tmp_path):
     )
 
 
+def _trigger_socket_and_listen(raw):
+    from photon_ml_tpu.cli.serve import check_socket_front
+
+    check_socket_front("/tmp/serve.sock", "127.0.0.1:8473")
+
+
 def _trigger_serving_store_version(raw, tmp_path):
     import json as _json
 
@@ -293,6 +299,13 @@ CASES = [
         "pipeline.depth=2 is not supported with --distributed",
         ValueError,
         _trigger_pipeline_distributed,
+    ),
+    (
+        "socket-and-listen",
+        "pass at most one of --socket / --listen (one socket front per "
+        "server process)",
+        ValueError,
+        _trigger_socket_and_listen,
     ),
     (
         "disk-slice-bad-layout",
