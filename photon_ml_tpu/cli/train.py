@@ -161,6 +161,16 @@ def build_parser() -> argparse.ArgumentParser:
     )
     p.add_argument("--hyper-parameter-tuning-iter", type=int, default=10)
     p.add_argument(
+        "--trial-lanes",
+        type=int,
+        default=1,
+        help="tuning trials trained concurrently as lambda lanes of one "
+        "batched solve (game/lanes.py): K candidates share each "
+        "coordinate's data residency and compiled kernel. 1 = the "
+        "sequential trial loop; the reference's cluster-of-trials "
+        "concurrency mapped onto one chip",
+    )
+    p.add_argument(
         "--hyper-parameter-config",
         default=None,
         help="JSON tuning config (HyperparameterSerialization.configFromJson "
@@ -812,7 +822,65 @@ def _run_tuning(args, estimator, raw, validation, coords, prior_results,
         value = sign * metric
         if ckpt is not None:
             ckpt.record_trial(unit_vec, value, r)
+        obs.current_run().registry.counter(
+            "photon_tuning_trials_total", "tuning trials completed"
+        ).inc()
         return value, r
+
+    def evaluate_batch(cands):
+        """Train a whole candidate batch as lambda lanes of ONE solve
+        (game/lanes.py): every lane shares each coordinate's data residency
+        and compiled executable, so K trials cost roughly one K-lane-wide
+        solve instead of K sequential fits."""
+        registry = obs.current_run().registry
+        combos = []
+        for unit_vec in cands:
+            native = hp.scale_up(unit_vec)
+            weights = {
+                n.removesuffix(".reg_weight"): float(v)
+                for n, v in zip(names, native)
+            }
+            combos.append(
+                {cc.name: weights.get(cc.name, cc.config.reg_weight) for cc in coords}
+            )
+        est = GameEstimator(
+            task=args.task,
+            coordinate_configs=list(coords),
+            n_cd_iterations=args.coordinate_descent_iterations,
+            evaluator_specs=[e for e in args.evaluators.split(",") if e],
+            partial_retrain_locked=list(estimator.partial_retrain_locked),
+            mesh=estimator.mesh,
+            validation_frequency=estimator.validation_frequency,
+            divergence_guard=estimator.divergence_guard,
+            rejection_tolerance=estimator.rejection_tolerance,
+            pipeline_depth=estimator.pipeline_depth,
+        )
+        with obs.span("tuning.batch", phase="tuning", lanes=len(cands)) as span:
+            lane_results = est.fit_lanes(
+                raw, combos, validation=validation,
+                datasets=datasets_fn() if datasets_fn is not None else None,
+            )
+        registry.histogram(
+            "photon_tuning_batch_wall_seconds",
+            "wall time of one lane-batched tuning trial batch",
+        ).observe(span.duration_s)
+        out = []
+        for unit_vec, r in zip(cands, lane_results):
+            # record lanes IN LANE ORDER: a mid-batch fault leaves a recorded
+            # prefix whose count alone realigns the (chunking-invariant)
+            # tuner candidate sequence on resume
+            faults.check("tuning.trial")
+            results.append(r)
+            value = sign * r.evaluation.primary_metric
+            if ckpt is not None:
+                ckpt.record_trial(
+                    unit_vec, value, r, lane=r.trackers.get("lane")
+                )
+            registry.counter(
+                "photon_tuning_trials_total", "tuning trials completed"
+            ).inc()
+            out.append((value, r))
+        return out
 
     # seed the tuner with the explicit-grid results (convertObservations);
     # skip grid points outside the search range — scale_down would clip them
@@ -865,19 +933,42 @@ def _run_tuning(args, estimator, raw, validation, coords, prior_results,
             logger.info("checkpoint: %d/%d tuning trials already run", n_done, n_iter)
         n_iter = max(n_iter - n_done, 0)
 
+    trial_lanes = int(getattr(args, "trial_lanes", 1) or 1)
     if n_iter > 0:
         tuner = get_tuner(args.hyper_parameter_tuning)
-        tuner.search(
-            n_iter,
-            hp.dim,
-            evaluate,
-            observations=observations,
-            discrete_params=hp.discrete_dims(),
-            seed=0,
-            # resumed deterministic (Sobol) searches must continue the
-            # original candidate sequence, not repeat its prefix
-            skip=args.hyper_parameter_tuning_iter - n_iter,
-        )
+        if trial_lanes > 1:
+            from ..game.lanes import check_lane_composition
+
+            check_lane_composition(
+                estimator, trial_lanes,
+                distributed=multihost.process_count() > 1,
+            )
+            tuner.search_batched(
+                n_iter,
+                hp.dim,
+                evaluate_batch,
+                trial_lanes,
+                observations=observations,
+                discrete_params=hp.discrete_dims(),
+                seed=0,
+                # resumed deterministic (Sobol) searches must continue the
+                # original candidate sequence, not repeat its prefix — the
+                # Sobol stream is chunking-invariant, so the trial COUNT
+                # alone realigns it even across a mid-batch kill
+                skip=args.hyper_parameter_tuning_iter - n_iter,
+            )
+        else:
+            tuner.search(
+                n_iter,
+                hp.dim,
+                evaluate,
+                observations=observations,
+                discrete_params=hp.discrete_dims(),
+                seed=0,
+                # resumed deterministic (Sobol) searches must continue the
+                # original candidate sequence, not repeat its prefix
+                skip=args.hyper_parameter_tuning_iter - n_iter,
+            )
 
     # record every (grid + tuned) observation as a reusable prior file
     priors = [
@@ -1222,24 +1313,32 @@ class _Checkpoint:
     def completed_trials(self):
         return list(self.state.get("tuning_trials", []))
 
-    def record_trial(self, unit_vec, value, result: GameResult):
+    def record_trial(self, unit_vec, value, result: GameResult, lane=None):
+        """``lane``: lane-batched sweeps (--trial-lanes) pass the trial's
+        lane tracker so a resumed run can tell how far through a batch the
+        interrupted run got — lanes record IN LANE ORDER, so the trial count
+        alone realigns the Sobol/GP sequence (chunking-invariant)."""
         i = len(self.state["tuning_trials"])
         model_dir = f"tuning-{i:03d}"
         self._save_model(model_dir, result.model, result.config)
-        self.state["tuning_trials"].append(
-            {
-                "unit": [float(x) for x in np.asarray(unit_vec).ravel()],
-                "value": float(value),
-                "reg_weights": result.config,
-                "model_dir": model_dir,
-                "metrics": None
-                if result.evaluation is None
-                else result.evaluation.metrics,
-                "primary_name": None
-                if result.evaluation is None
-                else result.evaluation.primary_name,
+        rec = {
+            "unit": [float(x) for x in np.asarray(unit_vec).ravel()],
+            "value": float(value),
+            "reg_weights": result.config,
+            "model_dir": model_dir,
+            "metrics": None
+            if result.evaluation is None
+            else result.evaluation.metrics,
+            "primary_name": None
+            if result.evaluation is None
+            else result.evaluation.primary_name,
+        }
+        if lane is not None:
+            rec["lane"] = {
+                "index": int(lane.get("index", 0)),
+                "n_lanes": int(lane.get("n_lanes", 1)),
             }
-        )
+        self.state["tuning_trials"].append(rec)
         self._write()
 
 

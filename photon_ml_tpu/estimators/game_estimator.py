@@ -489,6 +489,32 @@ class GameEstimator(EventEmitter):
         self.send_event(TrainingFinishEvent(time=_time.time()))
         return results
 
+    def fit_lanes(
+        self,
+        raw: RawDataset,
+        combos: Sequence[Mapping[str, float]],
+        validation: Optional[RawDataset] = None,
+        datasets: Optional[Dict[str, object]] = None,
+        n_cd_iterations: Optional[int] = None,
+    ) -> List[GameResult]:
+        """Train ``len(combos)`` reg-weight configurations as lambda LANES of
+        one batched coordinate-descent run (game/lanes.py): every lane shares
+        each coordinate's data residency and compiled solver, the per-lane
+        reg weight rides as a vector operand. Returns one GameResult per
+        combo, in order — the batched counterpart of calling :meth:`fit`
+        once per combo. See game.lanes.check_lane_composition for the
+        compositions this path refuses."""
+        from ..game.lanes import fit_lanes as _fit_lanes
+
+        return _fit_lanes(
+            self,
+            raw,
+            combos,
+            validation=validation,
+            datasets=datasets,
+            n_cd_iterations=n_cd_iterations,
+        )
+
     def select_best(self, results: Sequence[GameResult]) -> GameResult:
         """Best result by primary validation metric (falls back to the last)."""
         with_eval = [r for r in results if r.evaluation is not None]

@@ -146,6 +146,76 @@ class FixedEffectCoordinate(Coordinate):
             result,
         )
 
+    def train_lanes(
+        self,
+        residual_lanes: Array,  # f[n, L] per-lane residual scores
+        l2_lanes: Array,  # f[L] per-lane L2 weights
+        w0_lanes: Optional[Array] = None,  # f[d_true, L] warm start
+    ) -> Tuple[Array, SolverResult]:
+        """Lane-stacked train: L lambda candidates share this batch's data
+        residency and one compiled solve (game/lanes.py sweep executor).
+        Returns (coefficients f[d_true, L], per-lane SolverResult). The fault
+        site mirrors :meth:`train`: flat index 0 of the [n, L] offsets is row
+        0 / lane 0, so an injected NaN poisons exactly one lane."""
+        if self.dataset.streamed:
+            raise ValueError(
+                "trial-lanes sweeps require HBM-resident coordinates"
+                f" (coordinate {self.coordinate_id} is streamed)"
+            )
+        if self.config.down_sampling_rate < 1.0:
+            raise ValueError(
+                "down-sampling is not supported with trial-lanes"
+            )
+        batch = self.dataset.batch
+        L = residual_lanes.shape[1]
+        n_pad = batch.n_rows - residual_lanes.shape[0]
+        if n_pad > 0:
+            residual_lanes = jnp.concatenate(
+                [residual_lanes, jnp.zeros((n_pad, L), residual_lanes.dtype)]
+            )
+        offsets_lanes = batch.offsets[:, None] + residual_lanes
+        if faults.active():
+            offsets_lanes = faults.corrupt(
+                "solver.value_and_grad", offsets_lanes
+            )
+        if w0_lanes is not None and w0_lanes.shape[0] < batch.dim:
+            w0_lanes = jnp.concatenate(
+                [
+                    w0_lanes,
+                    jnp.zeros(
+                        (batch.dim - w0_lanes.shape[0], L), w0_lanes.dtype
+                    ),
+                ]
+            )
+        problem = GLMProblem(
+            task=self.task,
+            config=self.config,
+            normalization=self.normalization,
+            prior=self.prior_model.model.coefficients if self.prior_model else None,
+        )
+        W, result = problem.run_lanes(
+            batch, offsets_lanes, l2_lanes, w0=w0_lanes
+        )
+        d_true = self.dataset.dim
+        if W.shape[0] > d_true:
+            W = W[:d_true]
+        return W, result
+
+    def score_lanes(self, W: Array) -> Array:
+        """Per-sample scores [n, L] of lane-stacked coefficients W[d, L] —
+        one fused matmat instead of L matvec dispatches."""
+        feats = self.dataset.batch.features
+        dtype = self.dataset.batch.labels.dtype
+        W = jnp.asarray(W, dtype)
+        d_pad = feats.dim - W.shape[0]
+        if d_pad > 0:
+            W = jnp.concatenate(
+                [W, jnp.zeros((d_pad, W.shape[1]), W.dtype)]
+            )
+        scores = feats.matmat(W)
+        n_true = self.dataset.n_rows
+        return scores[:n_true] if scores.shape[0] > n_true else scores
+
     def _train_streamed(
         self,
         residual_scores: Optional[Array],
@@ -392,6 +462,98 @@ class RandomEffectCoordinate(Coordinate):
     @staticmethod
     def _train_fn():
         return _train_blocks if _re_solver_mode() == "vmapped" else _train_blocks_packed
+
+    def train_lanes(
+        self,
+        residual_lanes: Array,  # f[n, L] per-lane residual scores
+        l2_lanes: Array,  # f[L] per-lane L2 weights
+        w0_lanes: Optional[Array] = None,  # f[E, S, L] warm start
+    ) -> Tuple[Array, SolverResult]:
+        """Lane-stacked train: every (entity, lambda) pair is one lockstep
+        solver lane (game/lanes.py sweep executor). Returns (coef_values
+        f[E, S, L] zeroed outside each entity's support, per-lane
+        SolverResult with loss/reason [E, L]).
+
+        No size-bucketing here: bucketed stitching pads the trailing axis
+        (_concat_results.pad_cols), which on this path is the LANE axis — one
+        full-shape solve keeps the layout unambiguous, and the sweep already
+        amortizes the padding over L lambdas. The fault site mirrors
+        :meth:`train`: flat index 0 of the [E, K, L] offsets is entity 0 /
+        row 0 / lane 0."""
+        if self.dataset.streamed:
+            raise ValueError(
+                "trial-lanes sweeps require HBM-resident coordinates"
+                f" (coordinate {self.coordinate_id} is streamed)"
+            )
+        if self.prior_model is not None:
+            raise ValueError(
+                "regularize-by-prior is not supported with trial-lanes"
+            )
+        blocks = self.dataset.blocks
+        E, K, S = blocks.features.shape
+        dtype = blocks.labels.dtype
+        L = residual_lanes.shape[1]
+        res = jnp.take(
+            residual_lanes, jnp.maximum(blocks.active_rows, 0), axis=0
+        ) * (blocks.active_rows >= 0)[:, :, None]
+        offsets_lanes = blocks.offsets[:, :, None] + res.astype(dtype)
+        if faults.active():
+            offsets_lanes = faults.corrupt(
+                "solver.value_and_grad", offsets_lanes
+            )
+        # same host-numpy zeros policy as train(): CPU backend keeps w0 on
+        # host (device-created pjit inputs tickled an XLA:CPU segfault)
+        if jax.process_count() > 1 or jax.default_backend() == "cpu":
+            if w0_lanes is None:
+                w0 = np.zeros((E, S, L), np.dtype(jnp.zeros((), dtype).dtype))
+            else:
+                w0 = np.asarray(
+                    logged_fetch("coordinate.host_state", w0_lanes)
+                )
+        else:
+            w0 = (
+                jnp.zeros((E, S, L), dtype)
+                if w0_lanes is None
+                else jnp.asarray(w0_lanes, dtype)
+            )
+        solver_kwargs = self._solver_kwargs()
+        if solver_kwargs.pop("l1") > 0.0:
+            raise ValueError(
+                "trial-lanes sweeps support L2 regularization only (the "
+                "OWL-QN l1 weight is compile-time static, not a per-lane "
+                "operand)"
+            )
+        del solver_kwargs["l2"]  # replaced by the dynamic l2_lanes operand
+        results = _train_blocks_packed_lanes(
+            blocks.features,
+            blocks.labels,
+            offsets_lanes,
+            blocks.weights,
+            w0,
+            jnp.asarray(l2_lanes, dtype),
+            **solver_kwargs,
+        )
+        valid = blocks.proj_cols >= 0
+        W = jnp.where(valid[:, :, None], results.coefficients, 0.0)
+        return W, results
+
+    def score_lanes(self, coef_values: Array) -> Array:
+        """Per-sample scores [n, L] of lane-stacked per-entity coefficients
+        [E, S, L], reusing the densified-subspace cache of the sequential
+        scoring hot path (one row gather + fused dot for all L lanes)."""
+        from ..models.game import ell_row_subspace, score_entity_rows_dense_lanes
+
+        ds = self.dataset
+        row_entity = ds.row_entity
+        cache = getattr(ds, "_score_xsub_cache", None)
+        if cache is None:
+            cache = ell_row_subspace(
+                ds.blocks.proj_cols, row_entity, ds.ell_idx, ds.ell_val
+            )
+            object.__setattr__(ds, "_score_xsub_cache", cache)
+        score_dt = jnp.promote_types(ds.ell_val.dtype, ds.blocks.labels.dtype)
+        vals = jnp.asarray(coef_values, score_dt)
+        return score_entity_rows_dense_lanes(vals, row_entity, cache)
 
     def _train_streamed(
         self,
@@ -922,6 +1084,98 @@ def _train_blocks_packed(
         reason=res.reason,
         loss_history=res.loss_history.T,
         grad_norm_history=res.grad_norm_history.T,
+    )
+
+
+@partial(
+    jax.jit,
+    static_argnames=(
+        "task",
+        "optimizer_type",
+        "tolerance",
+        "max_iterations",
+        "num_corrections",
+        "max_cg_iterations",
+        "max_improvement_failures",
+    ),
+)
+def _train_blocks_packed_lanes(
+    features: Array,  # [E, K, S]
+    labels: Array,  # [E, K]
+    offsets_lanes: Array,  # [E, K, L] residual-composed per-lane offsets
+    weights: Array,  # [E, K]
+    w0: Array,  # [E, S, L]
+    l2_lanes: Array,  # f[L] — dynamic operand, NOT static: candidate
+    # refreshes must reuse the executable
+    *,
+    task: str,
+    optimizer_type: str,
+    tolerance: float,
+    max_iterations: int,
+    num_corrections: int,
+    max_cg_iterations: int,
+    max_improvement_failures: int,
+) -> SolverResult:
+    """Entity-minor lockstep solve widened by the lambda-lane axis.
+
+    Same contract as :func:`_train_blocks_packed`, with the solver lane set
+    the (entity, lambda) product: coefficients run as ``[S, E, L]`` so every
+    per-problem reduction stays axis-0 and the L2 weight vector broadcasts
+    from the trailing lane axis. One executable covers every candidate batch
+    of the same L (the lambdas are data, not shape)."""
+    loss = get_loss(task)
+    F = jnp.transpose(features, (1, 2, 0))  # [K, S, E]
+    y = labels.T[:, :, None]  # [K, E, 1]
+    off = jnp.transpose(offsets_lanes, (1, 0, 2)).astype(labels.dtype)  # [K, E, L]
+    wt = weights.T[:, :, None]
+    w0t = jnp.transpose(w0, (1, 0, 2)).astype(labels.dtype)  # [S, E, L]
+
+    def value_and_grad(w):  # [S, E, L] -> ([E, L], [S, E, L])
+        z = jnp.einsum("kse,sel->kel", F, w) + off  # [K, E, L]
+        lvals, dz = loss.loss_and_dz(z, y)
+        wdz = wt * dz
+        value = jnp.sum(wt * lvals, axis=0)  # [E, L]
+        grad = jnp.einsum("kse,kel->sel", F, wdz)  # [S, E, L]
+        value = value + 0.5 * l2_lanes * jnp.sum(w * w, axis=0)
+        grad = grad + l2_lanes * w
+        return value, grad
+
+    def hessian_vector(w, v):
+        z = jnp.einsum("kse,sel->kel", F, w) + off
+        c = wt * loss.d2z(z, y) * jnp.einsum("kse,sel->kel", F, v)
+        return jnp.einsum("kse,kel->sel", F, c) + l2_lanes * v
+
+    loss_tol, grad_tol = abs_tolerances(value_and_grad, w0t, tolerance)
+    if optimizer_type == "TRON":
+        res = solve_tron(
+            value_and_grad,
+            hessian_vector,
+            w0t,
+            loss_tol,
+            grad_tol,
+            max_iterations=max_iterations,
+            max_cg_iterations=max_cg_iterations,
+            max_improvement_failures=max_improvement_failures,
+        )
+    else:
+        res = solve_lbfgs(
+            value_and_grad,
+            w0t,
+            loss_tol,
+            grad_tol,
+            max_iterations=max_iterations,
+            num_corrections=num_corrections,
+            batched=True,
+        )
+    back = lambda a: jnp.transpose(a, (1, 0, 2))  # noqa: E731 — [S,E,L]->[E,S,L]
+    return SolverResult(
+        coefficients=back(res.coefficients),
+        loss=res.loss,  # [E, L]
+        gradient=back(res.gradient),
+        iterations=res.iterations,
+        reason=res.reason,  # [E, L]
+        loss_history=jnp.moveaxis(res.loss_history, 0, -1),  # [E, L, T]
+        grad_norm_history=jnp.moveaxis(res.grad_norm_history, 0, -1),
     )
 
 

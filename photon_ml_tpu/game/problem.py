@@ -12,6 +12,7 @@ sharded over the mesh, "single node" = the problem is one vmap lane.
 from __future__ import annotations
 
 import dataclasses
+from functools import partial
 from typing import Optional, Tuple
 
 import jax
@@ -21,12 +22,20 @@ import numpy as np
 from .. import obs
 from ..models.coefficients import Coefficients
 from ..models.glm import GeneralizedLinearModel, model_for_task
-from ..ops.features import LabeledBatch
+from ..ops.features import FeatureMatrix, LabeledBatch
 from ..ops.glm import GLMObjective, compute_variances
 from ..ops.losses import get_loss
 from ..ops.normalization import NormalizationContext
 from ..ops.regularization import NO_REGULARIZATION, RegularizationContext
-from ..optimize import OptimizerConfig, SolverResult, optimize
+from ..optimize import (
+    OptimizerConfig,
+    OptimizerType,
+    SolverResult,
+    optimize,
+    solve_lbfgs,
+    solve_tron,
+)
+from ..optimize.common import abs_tolerances
 
 Array = jax.Array
 
@@ -306,5 +315,147 @@ class GLMProblem:
         )
         return model, result
 
+    def run_lanes(
+        self,
+        batch: LabeledBatch,
+        offsets_lanes: Array,  # f[n, L] effective offsets per lambda lane
+        l2_lanes: Array,  # f[L] per-lane L2 weights (DYNAMIC operand)
+        w0: Optional[Array] = None,  # f[d, L] warm start; None = zeros
+    ) -> Tuple[Array, SolverResult]:
+        """Lane-stacked solve: L regularization candidates share one data
+        residency and ONE compiled kernel. The per-lane reg weight enters as a
+        vector operand (never a static argument), so a refreshed candidate set
+        from the tuner reuses the executable instead of recompiling.
+
+        Returns (coefficients f[d, L], per-lane SolverResult — loss/reason/
+        iterations all [L]). A lane that is born corrupt or diverges freezes
+        at its warm start with ``ConvergenceReason.NUMERICAL_DIVERGENCE``
+        without stalling its neighbors (PR 4's masked-commit machinery; see
+        optimize/lbfgs.py).
+
+        Composition limits (checked here because this is the deep entry
+        point; game/lanes.py pins the user-facing refusals): L2-only
+        regularization (the OWL-QN l1 weight is compile-time static, not a
+        per-lane operand), variance=NONE, no normalization, no prior."""
+        solver_cfg = self.config.solver_config()
+        if solver_cfg.l1_weight > 0.0:
+            raise ValueError(
+                "trial-lanes sweeps support L2 regularization only (the "
+                "OWL-QN l1 weight is compile-time static, not a per-lane "
+                "operand)"
+            )
+        if self.config.variance_type.upper() != "NONE":
+            raise ValueError(
+                "trial-lanes sweeps require variance=NONE (per-lane "
+                "Hessian inversion is not lane-stacked)"
+            )
+        if self.normalization is not None:
+            raise ValueError(
+                "feature normalization is not supported with trial-lanes"
+            )
+        if self.prior is not None:
+            raise ValueError(
+                "regularize-by-prior is not supported with trial-lanes"
+            )
+        dtype = batch.labels.dtype
+        L = offsets_lanes.shape[1]
+        if w0 is None:
+            w0 = jnp.zeros((batch.dim, L), dtype)
+        result = _train_fe_lanes(
+            batch.features,
+            batch.labels,
+            offsets_lanes,
+            batch.weights,
+            jnp.asarray(w0, dtype),
+            jnp.asarray(l2_lanes, dtype),
+            task=self.task,
+            optimizer_type=OptimizerType(solver_cfg.normalized_type()).value,
+            tolerance=solver_cfg.tolerance,
+            max_iterations=solver_cfg.max_iterations,
+            num_corrections=solver_cfg.num_corrections,
+            max_cg_iterations=solver_cfg.max_cg_iterations,
+            max_improvement_failures=solver_cfg.max_improvement_failures,
+        )
+        return result.coefficients, result
+
     def zero_model(self, dim: int, dtype=jnp.float32) -> GeneralizedLinearModel:
         return model_for_task(self.task, Coefficients.zeros(dim, dtype))
+
+
+@partial(
+    jax.jit,
+    static_argnames=(
+        "task",
+        "optimizer_type",
+        "tolerance",
+        "max_iterations",
+        "num_corrections",
+        "max_cg_iterations",
+        "max_improvement_failures",
+    ),
+)
+def _train_fe_lanes(
+    features: FeatureMatrix,
+    labels: Array,  # f[n]
+    offsets_lanes: Array,  # f[n, L]
+    weights: Array,  # f[n]
+    w0: Array,  # f[d, L]
+    l2_lanes: Array,  # f[L] — dynamic operand, NOT static: candidate
+    # refreshes must reuse the executable
+    *,
+    task: str,
+    optimizer_type: str,
+    tolerance: float,
+    max_iterations: int,
+    num_corrections: int,
+    max_cg_iterations: int,
+    max_improvement_failures: int,
+) -> SolverResult:
+    """Batched fixed-effect objective over the lambda-lane axis.
+
+    Same algebra as GLMObjective, with the coefficient vector widened to
+    ``[d, L]``: margins are one ``matmat`` ([n, L]), the gradient one
+    ``rmatmat`` ([d, L]), and the L2 term broadcasts the per-lane weight
+    vector. Every solver reduction is axis-0 (optimize/common._norm), so the
+    trailing lane axis rides through L-BFGS/TRON untouched — exactly the
+    entity-minor batched-solve contract of PR 4, with lambdas instead of
+    entities as the lane dimension."""
+    loss = get_loss(task)
+    y = labels[:, None]
+    wt = weights[:, None]
+
+    def value_and_grad(w):  # [d, L] -> ([L], [d, L])
+        z = features.matmat(w) + offsets_lanes  # [n, L]
+        lvals, dz = loss.loss_and_dz(z, y)
+        value = jnp.sum(wt * lvals, axis=0)  # [L]
+        grad = features.rmatmat(wt * dz)  # [d, L]
+        value = value + 0.5 * l2_lanes * jnp.sum(w * w, axis=0)
+        grad = grad + l2_lanes[None, :] * w
+        return value, grad
+
+    def hessian_vector(w, v):
+        z = features.matmat(w) + offsets_lanes
+        c = wt * loss.d2z(z, y) * features.matmat(v)  # [n, L]
+        return features.rmatmat(c) + l2_lanes[None, :] * v
+
+    loss_tol, grad_tol = abs_tolerances(value_and_grad, w0, tolerance)  # [L]
+    if optimizer_type == "TRON":
+        return solve_tron(
+            value_and_grad,
+            hessian_vector,
+            w0,
+            loss_tol,
+            grad_tol,
+            max_iterations=max_iterations,
+            max_cg_iterations=max_cg_iterations,
+            max_improvement_failures=max_improvement_failures,
+        )
+    return solve_lbfgs(
+        value_and_grad,
+        w0,
+        loss_tol,
+        grad_tol,
+        max_iterations=max_iterations,
+        num_corrections=num_corrections,
+        batched=True,
+    )

@@ -117,6 +117,21 @@ def score_entity_rows_dense(
 
 
 @jax.jit
+def score_entity_rows_dense_lanes(
+    coef_values: Array,  # f[E, S, L] lane-stacked per-entity coefficients
+    entity_rows: Array,  # i32[n], -1 = unseen entity
+    x_sub: Array,  # f[n, S] from ell_row_subspace
+) -> Array:
+    """Lane-stacked :func:`score_entity_rows_dense`: [n, L] scores for L
+    lambda lanes sharing one densified-subspace cache — the sweep executor's
+    RE scoring kernel (game/lanes.py)."""
+    safe_rows = jnp.maximum(entity_rows, 0)
+    w = jnp.take(coef_values, safe_rows, axis=0)  # [n, S, L]
+    scores = jnp.sum(w * x_sub[:, :, None], axis=1)  # [n, L]
+    return jnp.where(entity_rows[:, None] >= 0, scores, 0.0)
+
+
+@jax.jit
 def score_entity_ell_at(
     coef_values: Array,  # f[E, S]
     entity_rows: Array,  # i32[n], -1 = unseen entity

@@ -109,6 +109,24 @@ class FeatureMatrix:
         wv = jnp.take(w, self.coo_cols) * self.coo_vals
         return jnp.zeros(self.coo_n_rows, dtype=wv.dtype).at[self.coo_rows].add(wv)
 
+    def matmat(self, w: Array) -> Array:
+        """x @ w -> [n, L] for lane-stacked coefficients w[d, L].
+
+        The lambda-lane axis of batched hyperparameter sweeps: all L lanes
+        share this one feature residency and one fused kernel instead of L
+        separate matvec dispatches."""
+        if self.dense is not None:
+            return self.dense @ w
+        if self.idx is not None:
+            # take -> [n, k, L]; ELL values broadcast over the lane axis
+            return jnp.sum(
+                self.val[:, :, None] * jnp.take(w, self.idx, axis=0), axis=1
+            )
+        wv = jnp.take(w, self.coo_cols, axis=0) * self.coo_vals[:, None]
+        return jnp.zeros(
+            (self.coo_n_rows, w.shape[1]), dtype=wv.dtype
+        ).at[self.coo_rows].add(wv)
+
     def rmatvec(self, c: Array) -> Array:
         """x^T @ c -> [d]: the gradient-accumulation kernel."""
         if self.dense is not None:
@@ -122,6 +140,22 @@ class FeatureMatrix:
         return jnp.zeros(self.dim, dtype=contrib.dtype).at[self.coo_cols].add(
             contrib, indices_are_sorted=True
         )
+
+    def rmatmat(self, c: Array) -> Array:
+        """x^T @ c -> [d, L] for lane-stacked per-row weights c[n, L]: the
+        gradient-accumulation kernel of the lambda-lane sweep path."""
+        if self.dense is not None:
+            return self.dense.T @ c
+        if self.idx is not None:
+            contrib = c[:, None, :] * self.val[:, :, None]  # [n, k, L]
+            L = c.shape[1]
+            return jnp.zeros((self.dim, L), dtype=contrib.dtype).at[
+                self.idx.reshape(-1)
+            ].add(contrib.reshape(-1, L))
+        contrib = jnp.take(c, self.coo_rows, axis=0) * self.coo_vals[:, None]
+        return jnp.zeros((self.dim, c.shape[1]), dtype=contrib.dtype).at[
+            self.coo_cols
+        ].add(contrib, indices_are_sorted=True)
 
     def sq_rmatvec(self, c: Array) -> Array:
         """(x*x)^T @ c -> [d]: Hessian-diagonal accumulation."""

@@ -37,6 +37,14 @@ Two batching modes serve the random-effect solve (SURVEY.md §2.1 P8):
   cursor with per-lane validity (``rho == 0`` marks an invalid pair) instead
   of per-lane cursors, which only diverges in the rare curvature-guard case
   (``s.y`` too small on an improving step) — the optimum reached is the same.
+
+The lane shape is fully generic (``lanes = jnp.shape(f0)``, reductions over
+axis 0), so ``batched=True`` also drives lambda-lane stacks for lane-batched
+hyperparameter sweeps (game/lanes.py): ``w`` is ``[d, L]`` with one reg
+candidate per lane of a shared objective, or ``[S, E, L]`` for entity x
+lambda random-effect stacks. Masked commits are what make the sweep safe —
+a converged or diverged lambda lane freezes at its last committed iterate
+(per-lane ``ConvergenceReason``) without stalling or perturbing neighbors.
 """
 
 from __future__ import annotations

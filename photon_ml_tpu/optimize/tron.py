@@ -16,7 +16,16 @@ TPU are one extra fused matvec pair per CG step
 treeAggregate, here an XLA all-reduce when the batch is sharded).
 
 Masked state updates make the same code valid under vmap for batched
-per-entity TRON solves.
+per-entity TRON solves. The lane shape is generic (``lanes = jnp.shape(f0)``,
+reductions over axis 0), so the same solve also drives lambda-lane stacks for
+lane-batched hyperparameter sweeps (game/lanes.py): ``w`` is ``[d, L]`` (one
+reg candidate per lane) or ``[S, E, L]`` (entity x lambda), and masked
+commits freeze converged/diverged lanes at their last committed iterate —
+per-lane ``ConvergenceReason`` — without stalling or perturbing neighbors.
+The one lockstep artifact: every lane runs until ALL lanes finish, so a
+fast-converging lambda can accumulate a few extra (accepted, tiny) Newton
+steps vs its sequential solve — parity is ~1e-3, not bitwise
+(tests/test_sweep_lanes.py documents the tolerance).
 """
 
 from __future__ import annotations

@@ -21,3 +21,24 @@ def confidence_bound(mean: np.ndarray, var: np.ndarray, explore: float = 2.0) ->
     """Lower confidence bound, negated so that HIGHER = more promising
     (uniform "pick argmax of acquisition" convention)."""
     return -(mean - explore * np.sqrt(var))
+
+
+def constant_liar(values: np.ndarray, strategy: str = "min") -> float:
+    """Fantasy value for a pending (not-yet-evaluated) batch candidate:
+    the constant-liar heuristic behind greedy qEI (Ginsbourger et al. 2010).
+
+    Under the minimization convention the "min" lie is the MOST OPTIMISTIC
+    (pretend the pending point achieved the best value seen), which pushes
+    subsequent proposals away from it hardest — the diversity-preserving
+    choice for lane batches. "max" is the pessimistic lie, "mean" the
+    neutral one."""
+    v = np.asarray(values, dtype=np.float64)
+    if v.size == 0:
+        raise ValueError("constant_liar needs at least one observed value")
+    if strategy == "min":
+        return float(np.min(v))
+    if strategy == "max":
+        return float(np.max(v))
+    if strategy == "mean":
+        return float(np.mean(v))
+    raise ValueError(f"constant_liar strategy must be min|max|mean: {strategy!r}")

@@ -19,13 +19,17 @@ from typing import Callable, Dict, List, Optional, Sequence, Tuple
 import numpy as np
 from scipy.stats import qmc
 
-from .criteria import confidence_bound, expected_improvement
+from .criteria import confidence_bound, constant_liar, expected_improvement
 from .gp import GaussianProcessEstimator
 from .kernels import Matern52, StationaryKernel
 
 # EvaluationFunction contract (EvaluationFunction.scala:31-58):
 # candidate unit-vector -> (value_to_minimize, artifact)
 EvaluationFn = Callable[[np.ndarray], Tuple[float, object]]
+
+# Batched contract (lane-stacked sweeps, game/lanes.py): a [k, n_params]
+# candidate block -> k (value, artifact) pairs, one per lane, in order.
+BatchEvaluationFn = Callable[[np.ndarray], Sequence[Tuple[float, object]]]
 
 
 @dataclasses.dataclass
@@ -91,6 +95,69 @@ class RandomSearch:
             out.append(Observation(candidate=cand, value=float(value), artifact=artifact))
         return out
 
+    def _distinct(
+        self, cands: List[np.ndarray], cand: np.ndarray, tol: float = 1e-9
+    ) -> np.ndarray:
+        """Return ``cand``, replaced by fresh Sobol draws while it collides
+        with an already-proposed batch member (within ``tol`` in every dim).
+        Guarantees a batch of k proposals has k DISTINCT candidates — k
+        identical lanes would burn k-1 trials of budget on one point."""
+        for _ in range(100):
+            if not any(np.all(np.abs(c - cand) <= tol) for c in cands):
+                return cand
+            cand = _round_discrete(self.draw_candidates(1)[0], self.discrete_params)
+        return cand  # fully-saturated discrete grids: accept the collision
+
+    def propose_batch(
+        self,
+        k: int,
+        observations: Sequence[Observation],
+        prior_observations: Sequence[Observation],
+    ) -> np.ndarray:
+        """Propose k distinct candidates for one lane batch. Sobol points are
+        distinct by construction; dedup only guards discrete-rounded
+        collisions."""
+        out: List[np.ndarray] = []
+        for _ in range(k):
+            cand = _round_discrete(
+                self.next_candidate(observations, prior_observations),
+                self.discrete_params,
+            )
+            out.append(self._distinct(out, cand))
+        return np.stack(out)
+
+    def find_batched(
+        self,
+        n: int,
+        batch_size: int,
+        evaluate_batch: BatchEvaluationFn,
+        observations: Optional[Sequence[Observation]] = None,
+        prior_observations: Optional[Sequence[Observation]] = None,
+    ) -> List[Observation]:
+        """Evaluate n candidates in lane batches of ``batch_size``: propose a
+        distinct batch, evaluate all its lanes in one call, fold the results
+        back as ordinary observations, repeat. The final batch shrinks to the
+        remaining budget."""
+        observations = list(observations or [])
+        prior_observations = list(prior_observations or [])
+        out: List[Observation] = []
+        while len(out) < n:
+            k = min(batch_size, n - len(out))
+            cands = self.propose_batch(k, observations + out, prior_observations)
+            results = evaluate_batch(cands)
+            if len(results) != len(cands):
+                raise ValueError(
+                    f"evaluate_batch returned {len(results)} results for "
+                    f"{len(cands)} candidates"
+                )
+            for cand, (value, artifact) in zip(cands, results):
+                out.append(
+                    Observation(
+                        candidate=cand, value=float(value), artifact=artifact
+                    )
+                )
+        return out
+
 
 class GaussianProcessSearch(RandomSearch):
     """Bayesian search: GP posterior + expected improvement."""
@@ -133,3 +200,36 @@ class GaussianProcessSearch(RandomSearch):
         mu, var = posterior.predict(candidates)
         ei = expected_improvement(best, mu, var)
         return candidates[int(np.argmax(ei))]
+
+    def propose_batch(
+        self,
+        k: int,
+        observations: Sequence[Observation],
+        prior_observations: Sequence[Observation],
+    ) -> np.ndarray:
+        """Greedy qEI via the constant-liar heuristic: propose the EI argmax,
+        append a fantasy observation at the optimistic ("min") lie for it,
+        refit, repeat — so the k lanes of a batch spread over the acquisition
+        surface instead of piling onto one EI peak. Cold start (too few REAL
+        observations to fit a non-degenerate GP — lies are not evidence)
+        falls back to Sobol draws, which are distinct by construction."""
+        real = list(observations)
+        prior = list(prior_observations)
+        out: List[np.ndarray] = []
+        lies: List[Observation] = []
+        lie_pool = [o.value for o in real] + [o.value for o in prior]
+        for _ in range(k):
+            if len(real) <= self.n_params:
+                cand = self.draw_candidates(1)[0]
+            else:
+                cand = self.next_candidate(real + lies, prior)
+            cand = self._distinct(out, _round_discrete(cand, self.discrete_params))
+            out.append(cand)
+            if lie_pool:
+                lies.append(
+                    Observation(
+                        candidate=cand,
+                        value=constant_liar(np.asarray(lie_pool), "min"),
+                    )
+                )
+        return np.stack(out)

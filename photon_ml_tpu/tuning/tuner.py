@@ -14,7 +14,13 @@ from typing import List, Optional, Sequence
 
 import numpy as np
 
-from .search import EvaluationFn, GaussianProcessSearch, Observation, RandomSearch
+from .search import (
+    BatchEvaluationFn,
+    EvaluationFn,
+    GaussianProcessSearch,
+    Observation,
+    RandomSearch,
+)
 
 TUNER_DUMMY = "DUMMY"
 TUNER_RANDOM = "RANDOM"
@@ -42,6 +48,27 @@ class HyperparameterTuner:
         would have."""
         raise NotImplementedError
 
+    def search_batched(
+        self,
+        n: int,
+        dimension: int,
+        evaluate_batch: BatchEvaluationFn,
+        batch_size: int,
+        observations: Optional[Sequence[Observation]] = None,
+        discrete_params=None,
+        seed: int = 0,
+        skip: int = 0,
+    ) -> List[Observation]:
+        """Lane-batched :meth:`search`: candidates are proposed
+        ``batch_size`` at a time (distinct per batch; GP tuners use the
+        constant-liar heuristic) and ``evaluate_batch`` trains the whole
+        batch as lambda lanes of one solve (game/lanes.py). ``skip``
+        semantics match :meth:`search` — the candidate SEQUENCE is
+        chunking-invariant for deterministic tuners (the Sobol stream yields
+        the same points whether drawn 1 or k at a time), so a resumed run
+        continues the original sequence regardless of lane count."""
+        raise NotImplementedError
+
     @staticmethod
     def _check_skip(skip: int) -> int:
         if skip < 0:
@@ -51,12 +78,23 @@ class HyperparameterTuner:
             )
         return int(skip)
 
+    @staticmethod
+    def _check_batch(batch_size: int) -> int:
+        if batch_size < 1:
+            raise ValueError(f"batch_size must be >= 1: {batch_size}")
+        return int(batch_size)
+
 
 class DummyTuner(HyperparameterTuner):
     """No-op tuner (DummyTuner.scala:39): returns no new observations."""
 
     def search(self, n, dimension, evaluation_function, observations=None, discrete_params=None, seed=0, skip=0):
         self._check_skip(skip)
+        return []
+
+    def search_batched(self, n, dimension, evaluate_batch, batch_size, observations=None, discrete_params=None, seed=0, skip=0):
+        self._check_skip(skip)
+        self._check_batch(batch_size)
         return []
 
 
@@ -68,6 +106,16 @@ class RandomTuner(HyperparameterTuner):
             search.draw_candidates(skip)  # burn the consumed prefix
         return search.find(n, observations=observations)
 
+    def search_batched(self, n, dimension, evaluate_batch, batch_size, observations=None, discrete_params=None, seed=0, skip=0):
+        skip = self._check_skip(skip)
+        search = RandomSearch(dimension, lambda c: (0.0, None), discrete_params, seed)
+        if skip:
+            search.draw_candidates(skip)  # burn the consumed prefix
+        return search.find_batched(
+            n, self._check_batch(batch_size), evaluate_batch,
+            observations=observations,
+        )
+
 
 class BayesianTuner(HyperparameterTuner):
     def search(self, n, dimension, evaluation_function, observations=None, discrete_params=None, seed=0, skip=0):
@@ -77,6 +125,15 @@ class BayesianTuner(HyperparameterTuner):
         return GaussianProcessSearch(
             dimension, evaluation_function, discrete_params, seed=seed
         ).find(n, observations=observations)
+
+    def search_batched(self, n, dimension, evaluate_batch, batch_size, observations=None, discrete_params=None, seed=0, skip=0):
+        self._check_skip(skip)
+        return GaussianProcessSearch(
+            dimension, lambda c: (0.0, None), discrete_params, seed=seed
+        ).find_batched(
+            n, self._check_batch(batch_size), evaluate_batch,
+            observations=observations,
+        )
 
 
 def get_tuner(name: str) -> HyperparameterTuner:

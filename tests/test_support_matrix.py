@@ -194,8 +194,134 @@ def _trigger_serving_store_version(raw, tmp_path):
     ModelStore.open(str(d))
 
 
+def _lane_check(ccs, mesh=None, distributed=False, **est_kw):
+    from photon_ml_tpu.game.lanes import check_lane_composition
+
+    est = GameEstimator(
+        task="logistic_regression", coordinate_configs=ccs, mesh=mesh, **est_kw
+    )
+    check_lane_composition(est, 4, distributed=distributed)
+
+
+def _trigger_lanes_mesh(raw):
+    _lane_check([_fe()], mesh=mesh_mod.make_mesh(n_data=len(jax.devices())))
+
+
+def _trigger_lanes_multiprocess(raw):
+    _lane_check([_fe()], distributed=True)
+
+
+def _trigger_lanes_pipeline(raw):
+    _lane_check([_fe()], pipeline_depth=2)
+
+
+def _trigger_lanes_partial_retrain(raw):
+    _lane_check([_fe()], partial_retrain_locked=["global"])
+
+
+def _trigger_lanes_streamed(raw):
+    _lane_check([_fe(hbm_budget_mb=1)])
+
+
+def _trigger_lanes_l1(raw):
+    _lane_check(
+        [
+            _fe(
+                config=GLMOptimizationConfig(
+                    regularization=RegularizationContext("L1"), reg_weight=1.0
+                )
+            )
+        ]
+    )
+
+
+def _trigger_lanes_variance(raw):
+    _lane_check([_fe(config=_cfg(variance_type="SIMPLE"))])
+
+
+def _trigger_lanes_down_sampling(raw):
+    _lane_check([_fe(config=_cfg(down_sampling_rate=0.5))])
+
+
+def _trigger_lanes_normalization(raw):
+    d = 4
+    norm = build_normalization(
+        "STANDARDIZATION", np.ones(d), np.ones(d), np.ones(d), intercept_index=0
+    )
+    _lane_check([_fe(normalization=norm)])
+
+
+def _trigger_lanes_regularize_by_prior(raw):
+    _lane_check([_fe(regularize_by_prior=True)])
+
+
 CASES = [
     # (id, documented message fragment, exception type, trigger)
+    (
+        "lanes-mesh",
+        "trial-lanes sweeps are single-chip: not composable with a device "
+        "mesh",
+        ValueError,
+        _trigger_lanes_mesh,
+    ),
+    (
+        "lanes-multiprocess",
+        "trial-lanes sweeps are single-process: not composable with "
+        "multi-process training",
+        ValueError,
+        _trigger_lanes_multiprocess,
+    ),
+    (
+        "lanes-pipeline",
+        "trial-lanes sweeps drive their own lane schedule: not composable "
+        "with pipeline_depth > 1",
+        ValueError,
+        _trigger_lanes_pipeline,
+    ),
+    (
+        "lanes-partial-retrain",
+        "partial retraining (locked coordinates) is not supported with "
+        "trial-lanes",
+        ValueError,
+        _trigger_lanes_partial_retrain,
+    ),
+    (
+        "lanes-streamed",
+        "trial-lanes sweeps require HBM-resident coordinates",
+        ValueError,
+        _trigger_lanes_streamed,
+    ),
+    (
+        "lanes-l1",
+        "trial-lanes sweeps support L2 regularization only (the OWL-QN l1 "
+        "weight is compile-time static, not a per-lane operand)",
+        ValueError,
+        _trigger_lanes_l1,
+    ),
+    (
+        "lanes-variance",
+        "trial-lanes sweeps require variance=NONE",
+        ValueError,
+        _trigger_lanes_variance,
+    ),
+    (
+        "lanes-down-sampling",
+        "down-sampling is not supported with trial-lanes",
+        ValueError,
+        _trigger_lanes_down_sampling,
+    ),
+    (
+        "lanes-normalization",
+        "feature normalization is not supported with trial-lanes",
+        ValueError,
+        _trigger_lanes_normalization,
+    ),
+    (
+        "lanes-regularize-by-prior",
+        "regularize-by-prior is not supported with trial-lanes",
+        ValueError,
+        _trigger_lanes_regularize_by_prior,
+    ),
     (
         "feature-dtype-tiled-estimator",
         "feature_dtype is not supported with layout='tiled'",
