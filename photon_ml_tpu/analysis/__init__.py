@@ -8,13 +8,20 @@ The reference Photon ML leaned on scalac's type discipline for this class of
 invariant; a dynamically typed JAX port has to build its own. This package is
 that discipline, in two halves:
 
-- **static**: an AST linter (stdlib ``ast`` only) with four JAX-specific
-  rules — R1 implicit device transfer in hot-loop modules, R2 recompile
-  hazards inside ``@jit``, R3 dtype discipline (hardcoded itemsizes, dtype
-  literals), R4 swallow-and-continue exception handlers. Run it with
-  ``python -m photon_ml_tpu.analysis``; configure it from
-  ``[tool.photon-lint]`` in pyproject.toml; suppress individual lines with
-  ``# photon: ignore[RULE]``; grandfather findings in a checked-in baseline.
+- **static**: an AST linter (stdlib ``ast`` only) in two tiers. Per-file
+  rules R1-R8 — implicit device transfer in hot-loop modules, recompile
+  hazards inside ``@jit``, dtype discipline, swallow-and-continue handlers,
+  non-atomic writes, NaN mishandling, unattributed wall-clock timing,
+  module-level jax imports on the jax-free report path. Whole-program
+  passes R9-R12 (``analysis/project.py``) — a package-wide symbol table and
+  call graph feeding a thread-context race detector (R9), refusal-ledger
+  consistency against README/tests/``refusals.json`` (R10), the
+  ``photon_*`` metric-name contract (R11), and unused-suppression detection
+  (R12). Run it with ``python -m photon_ml_tpu.analysis``; configure it
+  from ``[tool.photon-lint]`` in pyproject.toml; suppress individual lines
+  with ``# photon: ignore[RULE]``; declare cross-thread intent with
+  ``# photon: guarded-by[lock_attr]`` / ``# photon: thread-confined``;
+  grandfather findings in a checked-in baseline.
 
 - **runtime**: :func:`transfer_guard`, a context manager the CD sweep and
   bench enter, which makes JAX hard-error on any *implicit* device->host
@@ -31,8 +38,10 @@ from .engine import (
     analyze_source,
     load_baseline,
     write_baseline,
+    write_refusal_inventory,
 )
-from .rules import RULES
+from .project import analyze_project
+from .rules import RULES, explain_rule
 from .runtime import allow_transfers, guard_level, logged_fetch, transfer_guard
 
 __all__ = [
@@ -42,7 +51,9 @@ __all__ = [
     "RULES",
     "allow_transfers",
     "analyze_paths",
+    "analyze_project",
     "analyze_source",
+    "explain_rule",
     "find_repo_root",
     "guard_level",
     "load_baseline",
@@ -50,4 +61,5 @@ __all__ = [
     "logged_fetch",
     "transfer_guard",
     "write_baseline",
+    "write_refusal_inventory",
 ]

@@ -13,16 +13,25 @@ import sys
 from typing import List, Optional
 
 from .config import load_config
-from .engine import analyze_paths, load_baseline, write_baseline
-from .rules import RULES
+from .engine import (
+    analyze_paths,
+    load_baseline,
+    write_baseline,
+    write_refusal_inventory,
+)
+from .rules import RULES, explain_rule
+
+# --json report layout version; bump on breaking shape changes
+JSON_SCHEMA_VERSION = 2
 
 
 def build_parser() -> argparse.ArgumentParser:
     p = argparse.ArgumentParser(
         prog="python -m photon_ml_tpu.analysis",
-        description="JAX-aware static analysis: transfer/recompile/dtype/"
-        "swallow lint (rules R1-R4) configured by [tool.photon-lint] "
-        "in pyproject.toml",
+        description="JAX-aware static analysis: per-file rules R1-R8 plus "
+        "the whole-program passes R9-R12 (thread races, refusal-ledger and "
+        "metric contracts, unused suppressions), configured by "
+        "[tool.photon-lint] in pyproject.toml",
     )
     p.add_argument(
         "paths",
@@ -51,6 +60,18 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument(
         "--list-rules", action="store_true", help="print the rule table and exit"
     )
+    p.add_argument(
+        "--explain",
+        metavar="RULE",
+        choices=sorted(RULES),
+        help="print one rule's doc, rationale, and good/bad example, then exit",
+    )
+    p.add_argument(
+        "--write-refusal-inventory",
+        action="store_true",
+        help="regenerate refusals.json from the README ledger and the "
+        "package's raise sites, then exit 0",
+    )
     return p
 
 
@@ -60,11 +81,19 @@ def main(argv: Optional[List[str]] = None) -> int:
         for rule, desc in sorted(RULES.items()):
             print(f"{rule}  {desc}")
         return 0
+    if args.explain:
+        print(explain_rule(args.explain))
+        return 0
     try:
         config = load_config(pyproject=args.config)
     except ValueError as e:
         print(f"error: {e}", file=sys.stderr)
         return 2
+
+    if args.write_refusal_inventory:
+        path, n = write_refusal_inventory(config)
+        print(f"wrote {n} refusal(s) to {path}")
+        return 0
 
     baseline_path = args.baseline or config.baseline_path
     try:
@@ -89,6 +118,7 @@ def main(argv: Optional[List[str]] = None) -> int:
         print(
             json.dumps(
                 {
+                    "schema_version": JSON_SCHEMA_VERSION,
                     "files_scanned": result.files_scanned,
                     "parse_errors": result.parse_errors,
                     "findings": [f.to_dict() for f in result.findings],

@@ -84,6 +84,18 @@ class LintConfig:
     atomic_write_modules: Tuple[str, ...] = DEFAULT_ATOMIC_WRITE_MODULES
     timing_strict_modules: Tuple[str, ...] = DEFAULT_TIMING_STRICT_MODULES
     jax_free_modules: Tuple[str, ...] = DEFAULT_JAX_FREE_MODULES
+    # R9: extra thread entrypoints ("path/to/file.py::Class.method") the call
+    # graph cannot discover structurally — e.g. a bound method handed to
+    # another object's constructor and invoked from that object's thread.
+    # Unknown specs are a config error, like an unknown ignore[RULE].
+    thread_entrypoints: Tuple[str, ...] = ()
+    # R10: the refusal-ledger triangle — machine-readable inventory, the
+    # README ledger table, and the support-matrix pin test.
+    refusal_inventory: str = "refusals.json"
+    refusal_docs: str = "README.md"
+    refusal_tests: str = "tests/test_support_matrix.py"
+    # R11: where photon_* series must be documented.
+    metric_docs: Tuple[str, ...] = ("README.md",)
     root: str = "."
 
     def is_hot(self, relpath: str) -> bool:

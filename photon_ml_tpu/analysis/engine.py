@@ -35,6 +35,7 @@ from collections import Counter
 from typing import Dict, List, Optional, Sequence, Set, Tuple
 
 from .config import LintConfig
+from .project import PROJECT_RULE_IDS, Annotation, analyze_project
 from .rules import RULES, run_rules
 
 _SUPPRESS_RE = re.compile(r"#\s*photon:\s*ignore\[([A-Za-z0-9_,\s]+)\]")
@@ -182,26 +183,159 @@ def analyze_paths(
     config: Optional[LintConfig] = None,
     baseline: Optional[Counter] = None,
     rules: Optional[Sequence[str]] = None,
+    project: Optional[bool] = None,
 ) -> LintResult:
-    """Lint files/directories; default paths come from the config."""
+    """Lint files/directories; default paths come from the config.
+
+    The whole-program passes (R9-R11, plus R12's unused-suppression sweep)
+    need the complete package to build an honest call graph, so they run
+    only on full configured-path runs — linting an explicit file subset
+    stays per-file. ``project`` overrides the auto-detection either way.
+    """
     config = config or LintConfig()
     files = iter_python_files(paths or config.paths, config)
     root = os.path.abspath(config.root)
     findings: List[Finding] = []
     errors: List[str] = []
+    sources: Dict[str, str] = {}
+    sup_maps: Dict[str, Dict[int, Set[str]]] = {}
     for path in files:
         rel = os.path.relpath(path, root).replace(os.sep, "/")
         try:
             with open(path, encoding="utf-8") as f:
                 source = f.read()
-            findings.extend(analyze_source(source, rel, config, rules=rules))
+            file_findings = analyze_source(source, rel, config, rules=rules)
         except (SyntaxError, ValueError) as e:
             errors.append(f"{rel}: {e}")
+            continue
+        findings.extend(file_findings)
+        sources[rel] = source
+        sup_maps[rel] = _suppressions(source)
+
+    enabled = set(rules) if rules is not None else set(RULES)
+    run_project = project if project is not None else paths is None
+    rules_run = set(enabled)
+    if not run_project:
+        rules_run -= set(PROJECT_RULE_IDS)
+    annotations: List[Annotation] = []
+    used_ann: Set[Tuple[str, int]] = set()
+    if run_project and enabled & set(PROJECT_RULE_IDS):
+        pres = analyze_project(sources, config, rules=sorted(enabled))
+        errors.extend(pres.errors)
+        annotations = pres.annotations
+        used_ann = pres.used_annotations
+        for pf in pres.findings:
+            findings.append(
+                Finding(
+                    file=pf.file,
+                    line=pf.line,
+                    col=pf.col,
+                    rule=pf.rule,
+                    message=pf.message,
+                    code=_source_line(sources, root, pf.file, pf.line),
+                    suppressed=pf.rule
+                    in sup_maps.get(pf.file, {}).get(pf.line, ()),
+                )
+            )
+    if run_project and "R12" in enabled:
+        findings.extend(
+            _unused_suppression_findings(
+                sources, sup_maps, findings, annotations, used_ann, rules_run
+            )
+        )
+    findings.sort(key=lambda f: (f.file, f.line, f.col, f.rule))
     if baseline:
         findings = apply_baseline(findings, baseline)
     return LintResult(
         findings=findings, files_scanned=len(files), parse_errors=errors
     )
+
+
+def _source_line(
+    sources: Dict[str, str], root: str, rel: str, line: int
+) -> str:
+    """The stripped source line backing a finding — from the scanned sources
+    when possible, else from disk (R10/R11 findings land on README rows,
+    test pins, and refusals.json, none of which are linted files)."""
+    text = sources.get(rel)
+    if text is None:
+        path = os.path.join(root, rel)
+        if not os.path.isfile(path):
+            return ""
+        try:
+            with open(path, encoding="utf-8") as f:
+                text = f.read()
+        except OSError:
+            return ""
+    lines = text.splitlines()
+    return lines[line - 1].strip() if 0 < line <= len(lines) else ""
+
+
+def _unused_suppression_findings(
+    sources: Dict[str, str],
+    sup_maps: Dict[str, Dict[int, Set[str]]],
+    findings: Sequence[Finding],
+    annotations: Sequence[Annotation],
+    used_annotations: Set[Tuple[str, int]],
+    rules_run: Set[str],
+) -> List[Finding]:
+    """R12: suppressions and annotations that silenced nothing. Checked only
+    for rules that actually ran this invocation — a ``--rule R8`` pass must
+    not declare every R4 ignore stale."""
+    used = {(f.file, f.line, f.rule) for f in findings if f.suppressed}
+    out: List[Finding] = []
+    for rel in sorted(sup_maps):
+        lines = sources[rel].splitlines()
+        for line, rules_at in sorted(sup_maps[rel].items()):
+            for rule in sorted(rules_at):
+                if rule == "R12" or rule not in rules_run:
+                    continue
+                if (rel, line, rule) in used:
+                    continue
+                code = (
+                    lines[line - 1].strip() if 0 < line <= len(lines) else ""
+                )
+                out.append(
+                    Finding(
+                        file=rel,
+                        line=line,
+                        col=0,
+                        rule="R12",
+                        message=(
+                            f"photon: ignore[{rule}] suppresses no finding — "
+                            "delete the stale suppression"
+                        ),
+                        code=code,
+                        suppressed="R12" in sup_maps[rel].get(line, ()),
+                    )
+                )
+    if "R9" in rules_run:
+        for ann in annotations:
+            if (ann.file, ann.line) in used_annotations:
+                continue
+            lines = sources.get(ann.file, "").splitlines()
+            code = (
+                lines[ann.line - 1].strip()
+                if 0 < ann.line <= len(lines)
+                else ""
+            )
+            out.append(
+                Finding(
+                    file=ann.file,
+                    line=ann.line,
+                    col=0,
+                    rule="R12",
+                    message=(
+                        f"photon: {ann.kind} annotation suppresses no R9 "
+                        "finding — the attribute is not shared across "
+                        "thread contexts; delete the stale annotation"
+                    ),
+                    code=code,
+                    suppressed="R12"
+                    in sup_maps.get(ann.file, {}).get(ann.line, ()),
+                )
+            )
+    return out
 
 
 # --------------------------------------------------------------------------
@@ -249,3 +383,40 @@ def write_baseline(findings: Sequence[Finding], path: str) -> int:
         )
         f.write("\n")
     return len(entries)
+
+
+# --------------------------------------------------------------------------
+# refusal inventory (R10's --write-refusal-inventory counterpart)
+
+
+def write_refusal_inventory(config: LintConfig) -> Tuple[str, int]:
+    """Regenerate ``refusals.json`` from the current tree: the README ledger
+    rows matched against the package's raise sites. Returns (path, entries).
+    Same contract as --write-baseline: the checked-in file must be
+    byte-identical to a fresh run or the R10 pass fails."""
+    from .project import (
+        build_refusal_inventory,
+        extract_raise_sites,
+        parse_refusal_ledger,
+        render_refusal_inventory,
+    )
+
+    root = os.path.abspath(config.root)
+    sources: Dict[str, str] = {}
+    for path in iter_python_files(config.paths, config):
+        rel = os.path.relpath(path, root).replace(os.sep, "/")
+        try:
+            with open(path, encoding="utf-8") as f:
+                sources[rel] = f.read()
+        except OSError:
+            continue
+    docs_path = os.path.join(config.root, config.refusal_docs)
+    ledger = []
+    if os.path.isfile(docs_path):
+        with open(docs_path, encoding="utf-8") as f:
+            ledger = parse_refusal_ledger(f.read())
+    doc = build_refusal_inventory(ledger, extract_raise_sites(sources))
+    out_path = os.path.join(config.root, config.refusal_inventory)
+    with open(out_path, "w", encoding="utf-8") as f:
+        f.write(render_refusal_inventory(doc))
+    return out_path, len(doc["refusals"])

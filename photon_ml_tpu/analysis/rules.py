@@ -56,6 +56,37 @@ R8 jax-free-import: a module-level ``import jax`` / ``from jax... import``
    jax inside the function that needs it, or under ``if TYPE_CHECKING:``
    for annotations.
 
+R9 thread-context-race (whole-program; ``analysis/project.py``): an
+   instance attribute or mutated module global written in one execution
+   context (a thread entrypoint, discovered or configured) and read or
+   written in another without a common lock held on both sides — held
+   lexically via ``with self._lock:`` or provably inherited from every call
+   site. Declare intent the call graph cannot see on the assignment line:
+   ``# photon: guarded-by[lock_attr]`` (validated against the class's real
+   lock attributes) or ``# photon: thread-confined`` for
+   handoff-at-a-barrier patterns (written by one thread, read by another
+   only after an Event/join rendezvous).
+
+R10 refusal-ledger-drift (whole-program): the typed-refusal raise sites,
+   the README refusal-ledger table, the support-matrix test pins, and the
+   checked-in ``refusals.json`` inventory must agree. A documented fragment
+   no raise site produces, a pin the ledger omits, a ledger row no pin
+   covers, a refusal-phrased raise the ledger does not document, and a
+   stale inventory are each findings.
+
+R11 metric-contract (whole-program): every literal ``photon_*`` series
+   registration is checked against the naming conventions (counters end
+   ``_total`` and nothing else does; no Prometheus-reserved
+   ``_count``/``_sum``/``_bucket`` suffixes; lowercase snake_case), one
+   kind and one label-key set per family, and two-way drift against the
+   README metrics reference.
+
+R12 unused-suppression: a ``# photon: ignore[RULE]`` that suppresses no
+   finding, or a ``guarded-by``/``thread-confined`` annotation R9 never
+   needed, is itself a finding (mypy's warn-unused-ignores) — stale
+   suppressions silently disable future findings at that site. Only
+   checked for rules that actually ran.
+
 Taint tracking is deliberately local and conservative: names become
 "jax-typed" through parameter annotations (``Array``, ``jax.Array``, ...)
 and through assignment from expressions rooted at ``jnp.`` / ``jax.`` calls
@@ -81,6 +112,10 @@ RULES: Dict[str, str] = {
     "R6": "NaN mishandling (== nan compare / uncounted isnan patch)",
     "R7": "direct wall-clock timing in a timing-strict module (use obs.span/timed)",
     "R8": "module-level jax import in a jax-free module",
+    "R9": "cross-thread shared-state access with no common lock",
+    "R10": "refusal ledger drift (code / README / test pins / refusals.json)",
+    "R11": "photon_* metric-name contract violation",
+    "R12": "unused suppression or annotation",
 }
 
 # attributes whose value is host metadata, not an array: reading them off a
@@ -887,8 +922,8 @@ def _run_r6(mod: _Module, hot: bool, add: AddFn) -> None:
 # invisible to phase attribution, Chrome-trace export, and the JSONL stream.
 # Route the section through obs.span(...) / utils.timed(...) and read the
 # span's duration_s instead. Cross-thread timestamp plumbing that cannot be
-# a span (e.g. enqueue stamps handed to another thread) suppresses with
-# # photon: ignore[R7].
+# a span (e.g. enqueue stamps handed to another thread) suppresses with a
+# per-site ignore[R7] comment.
 
 _TIMING_CALLS = {"time.time", "time.perf_counter", "time.monotonic"}
 
@@ -1013,3 +1048,104 @@ def run_rules(
         _run_r8(mod, adder("R8"))
     out.sort(key=lambda f: (f.line, f.col, f.rule))
     return out
+
+
+# --------------------------------------------------------------------------
+# --explain: per-rule documentation, sourced from this module's docstring so
+# the CLI text and the reference text are one artifact and cannot drift.
+
+
+def _docstring_sections() -> Dict[str, str]:
+    """The ``R<n> ...`` paragraphs of the module docstring, keyed by rule."""
+    sections: Dict[str, str] = {}
+    current: Optional[str] = None
+    buf: List[str] = []
+    for line in (__doc__ or "").splitlines():
+        m = re.match(r"^(R\d+)\s", line)
+        if m and m.group(1) in RULES:
+            if current is not None:
+                sections[current] = "\n".join(buf).rstrip()
+            current, buf = m.group(1), [line]
+        elif current is not None and (not line or line.startswith(" ")):
+            buf.append(line)
+        elif current is not None:
+            sections[current] = "\n".join(buf).rstrip()
+            current, buf = None, []
+    if current is not None:
+        sections[current] = "\n".join(buf).rstrip()
+    return sections
+
+
+# (bad, good) minimal examples per rule, printed by --explain
+RULE_EXAMPLES: Dict[str, Tuple[str, str]] = {
+    "R1": (
+        "loss = float(loss_dev)          # blocks on device->host sync",
+        'loss = logged_fetch(loss_dev, "cd.loss")  # counted, attributed',
+    ),
+    "R2": (
+        "@jax.jit\ndef f(x):\n    if x > 0:            # tracer in Python control flow\n        return x",
+        "@jax.jit\ndef f(x):\n    return jnp.where(x > 0, x, 0.0)",
+    ),
+    "R3": (
+        "hbm_bytes = n_rows * n_cols * 4   # wrong for x64 inputs",
+        "hbm_bytes = n_rows * n_cols * arr.dtype.itemsize",
+    ),
+    "R4": (
+        "except Exception:\n    pass                    # error vanishes from metrics.jsonl",
+        'except Exception:\n    obs.swallowed_error("decode")\n    part = None',
+    ),
+    "R5": (
+        'with open(ckpt_path, "w") as f:   # torn file on crash\n    f.write(payload)',
+        "atomic_write_text(ckpt_path, payload)  # temp + fsync + rename",
+    ),
+    "R6": (
+        "if x == jnp.nan:                 # always False",
+        "if bool(jnp.isnan(x)):",
+    ),
+    "R7": (
+        "t0 = time.perf_counter()\nsolve()\ndt = time.perf_counter() - t0   # invisible to the timeline",
+        'with obs.span("solver.solve"):\n    solve()',
+    ),
+    "R8": (
+        "import jax                        # at module level in obs/",
+        "def rebuild():\n    import jax    # only the caller that needs it pays",
+    ),
+    "R9": (
+        "def _worker(self):\n    self._live = snap          # worker thread writes\n"
+        "def poke(self):\n    return self._live          # main thread reads, no lock",
+        "def _worker(self):\n    with self._lock:\n        self._live = snap\n"
+        "def poke(self):\n    with self._lock:\n        return self._live\n"
+        "# or, when a barrier transfers ownership:\n"
+        "self._value = None  # photon: thread-confined — read only after _done.wait()",
+    ),
+    "R10": (
+        'raise ValueError("streaming is not supported with mesh sharding")\n'
+        "# ...but no README refusal-ledger row / test pin mentions it",
+        "# README ledger row + tests/test_support_matrix.py pin + refusals.json\n"
+        "# entry all match the raise site (regenerate with\n"
+        "# --write-refusal-inventory)",
+    ),
+    "R11": (
+        'REG.counter("photon_requests")    # counter without _total',
+        'REG.counter("photon_requests_total")',
+    ),
+    "R12": (
+        "x = compute()  # photon: ignore[R4] — but nothing fires here",
+        "x = compute()  # stale suppression deleted",
+    ),
+}
+
+
+def explain_rule(rule: str) -> str:
+    """Human-readable doc block for one rule: summary, rationale, examples."""
+    sections = _docstring_sections()
+    out = [f"{rule}: {RULES[rule]}", ""]
+    doc = sections.get(rule)
+    if doc:
+        out.extend([doc, ""])
+    bad, good = RULE_EXAMPLES[rule]
+    out.append("bad:")
+    out.extend(f"    {line}" for line in bad.splitlines())
+    out.append("good:")
+    out.extend(f"    {line}" for line in good.splitlines())
+    return "\n".join(out)

@@ -72,19 +72,26 @@ class RunTelemetry(EventEmitter):
         self.clear_listeners()
 
 
+# guards _current: instrumentation sites read it from worker threads (the
+# refresh watcher, batcher workers, HTTP scrape handlers) while use_run
+# swaps it on the training thread — get/set hold the lock, and the
+# RunTelemetry object itself is internally thread-safe past the handoff
+_current_lock = threading.Lock()
 _current = RunTelemetry()
 
 
 def current_run() -> RunTelemetry:
-    return _current
+    with _current_lock:
+        return _current
 
 
 def set_current_run(run: Optional[RunTelemetry]) -> RunTelemetry:
     """Install ``run`` as the current telemetry scope (None installs a fresh
     passive one) and return the previous scope so callers can restore it."""
     global _current
-    prev = _current
-    _current = run if run is not None else RunTelemetry()
+    with _current_lock:
+        prev = _current
+        _current = run if run is not None else RunTelemetry()
     return prev
 
 
@@ -100,7 +107,7 @@ def use_run(run: RunTelemetry):
 def active() -> bool:
     """True when some sink is listening — i.e. when it is worth paying for
     device fetches to feed the telemetry."""
-    return _current.has_listeners()
+    return current_run().has_listeners()
 
 
 def swallowed_error(site: str) -> None:
@@ -111,7 +118,7 @@ def swallowed_error(site: str) -> None:
     that neither re-raises nor calls this is flagged as an invisible
     swallow. Cheap host-only registry work — safe in any handler, including
     inside event-dispatch error paths."""
-    _current.registry.counter(
+    current_run().registry.counter(
         "photon_swallowed_errors_total",
         "exceptions swallowed by degrade-and-continue handlers",
     ).labels(site=site).inc()
@@ -127,7 +134,7 @@ def record_solver_metrics(solver: str, result) -> None:
     random-effect train functions, where there is nothing concrete to read
     (those solves are covered by the trackers instead).
     """
-    run = _current
+    run = current_run()
     if not run.has_listeners():
         return
     import jax

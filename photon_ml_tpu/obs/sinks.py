@@ -72,8 +72,8 @@ class JsonlSink(EventListener):
         # buffering=1: line-buffered, so even a write the explicit flush
         # below never reaches (e.g. an exception between write and flush)
         # hits the OS at the newline
-        # photon: ignore[R5] — append-only JSONL stream; atomic rename
-        # semantics would overwrite earlier lines of the same run
+        # append-only JSONL stream: atomic-rename semantics would overwrite
+        # earlier lines of the same run, so a direct open() is correct here
         self._f: Optional[object] = open(path, "a", buffering=1, encoding="utf-8")
 
     def handle(self, event) -> None:

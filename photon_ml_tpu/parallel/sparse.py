@@ -139,6 +139,9 @@ class TiledSparseMatrix:
         return self._rmat(c, square=True)
 
     def to_dense(self) -> Array:
+        # photon: ignore[R10] — internal API guard on a layout class, not a
+        # user-facing configuration refusal; the supported paths are named
+        # in the message, and no config combination routes here
         raise NotImplementedError(
             "TiledSparseMatrix is for huge d; densification is not supported "
             "(use variance_type SIMPLE, or FULL which runs the chunked "

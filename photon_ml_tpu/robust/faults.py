@@ -280,6 +280,12 @@ def configure(spec, seed: int = 0) -> FaultInjector:
     """Install an injector from a spec string or list of FaultSpecs."""
     global _injector
     specs = parse_faults(spec) if isinstance(spec, str) else list(spec)
+    # lock-free publish by design: check()/corrupt() run on hot serving and
+    # IO threads and must stay a single is-None test, so workers snapshot
+    # the reference once per call (inj = _injector) and CPython reference
+    # assignment is atomic — a reader sees the old or the new injector,
+    # never a torn one
+    # photon: thread-confined
     _injector = FaultInjector(specs, seed=seed)
     return _injector
 

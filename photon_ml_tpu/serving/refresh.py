@@ -107,6 +107,10 @@ class RefreshWatcher:
         self._on_flip = on_flip
         self.poll_seconds = float(poll_seconds)
         self._live = live
+        # serializes _check between the poll thread and poke() callers: both
+        # run the read-compare-flip of _live, and an unserialized pair could
+        # load the same snapshot twice or publish flips out of order
+        self._check_lock = threading.Lock()
         self._stop = threading.Event()
         self._thread = threading.Thread(
             target=self._run, name="photon-serving-refresh", daemon=True
@@ -122,26 +126,27 @@ class RefreshWatcher:
         self._check()
 
     def _check(self) -> None:
-        try:
-            # the refresh chaos site: PHOTON_FAULTS serving.refresh:delay:...
-            # stalls a flip mid-poll, serving.refresh:io:... raises into the
-            # swallow-and-retry path below while the live model keeps serving
-            faults.check("serving.refresh")
-            name = current_snapshot(self.serving_root)
-            if name is None or name == self._live:
+        with self._check_lock:
+            try:
+                # the refresh chaos site: PHOTON_FAULTS serving.refresh:delay:...
+                # stalls a flip mid-poll, serving.refresh:io:... raises into the
+                # swallow-and-retry path below while the live model keeps serving
+                faults.check("serving.refresh")
+                name = current_snapshot(self.serving_root)
+                if name is None or name == self._live:
+                    return
+                store = ModelStore.open(snapshot_path(self.serving_root, name))
+            except Exception:
+                # a torn/late publish must not take down serving: keep the live
+                # model, surface the failure in metrics, retry next poll
+                obs.swallowed_error("serving.refresh")
                 return
-            store = ModelStore.open(snapshot_path(self.serving_root, name))
-        except Exception:
-            # a torn/late publish must not take down serving: keep the live
-            # model, surface the failure in metrics, retry next poll
-            obs.swallowed_error("serving.refresh")
-            return
-        self._on_flip(name, store)
-        self._live = name
-        obs.current_run().registry.counter(
-            "photon_serving_refresh_total",
-            "model snapshots flipped in without downtime",
-        ).inc()
+            self._on_flip(name, store)
+            self._live = name
+            obs.current_run().registry.counter(
+                "photon_serving_refresh_total",
+                "model snapshots flipped in without downtime",
+            ).inc()
 
     def _run(self) -> None:
         while not self._stop.is_set():

@@ -28,8 +28,10 @@ class DaemonFuture:
 
     def __init__(self, fn):
         self._done = threading.Event()
-        self._value = None
-        self._error = None
+        # ownership handoff at the _done barrier: _work (the daemon thread)
+        # is the only writer, and result() reads only after _done.wait()
+        self._value = None  # photon: thread-confined
+        self._error = None  # photon: thread-confined
 
         def _work():
             try:

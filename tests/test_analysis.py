@@ -23,7 +23,13 @@ from photon_ml_tpu.analysis import (
     transfer_guard,
     write_baseline,
 )
-from photon_ml_tpu.analysis.cli import main as lint_main
+from photon_ml_tpu.analysis.cli import JSON_SCHEMA_VERSION, main as lint_main
+from photon_ml_tpu.analysis.engine import write_refusal_inventory
+from photon_ml_tpu.analysis.project import (
+    analyze_project,
+    fragment_matches_template,
+)
+from photon_ml_tpu.analysis.rules import RULES, explain_rule
 
 HOT = "photon_ml_tpu/game/descent.py"  # matches default hot_loop_modules
 COLD = "photon_ml_tpu/models/somewhere.py"
@@ -627,7 +633,7 @@ def test_docstring_mention_does_not_suppress():
 
 def test_unknown_rule_in_ignore_is_an_error():
     src = """
-    x = 1  # photon: ignore[R9]
+    x = 1  # photon: ignore[R99]
     """
     with pytest.raises(ValueError, match="unknown rule"):
         findings(src)
@@ -767,6 +773,528 @@ def test_cli_parse_error_fails(tmp_path, capsys):
     py = _write_pyproject(tmp_path)
     assert lint_main(["--config", py]) == 1
     assert "parse error" in capsys.readouterr().err
+
+
+# ------------------------------------------------- R9 (cross-thread races)
+
+
+def proj(sources, rules=("R9",), config=None):
+    return analyze_project(
+        {rel: textwrap.dedent(src) for rel, src in sources.items()},
+        config or LintConfig(),
+        rules=rules,
+    )
+
+
+RACY_WORKER = {
+    "pkg/worker.py": """
+    import threading
+
+    class Worker:
+        def __init__(self):
+            self.value = 0
+            self.thread = threading.Thread(target=self._work)
+
+        def start(self):
+            self.thread.start()
+
+        def _work(self):
+            self.value = 1
+
+        def read(self):
+            return self.value
+    """
+}
+
+
+def test_r9_flags_unguarded_cross_thread_write():
+    res = proj(RACY_WORKER)
+    assert [f.rule for f in res.findings] == ["R9"]
+    assert "Worker.value" in res.findings[0].message
+    assert "no common lock" in res.findings[0].message
+    assert res.errors == []
+
+
+def test_r9_lock_on_both_sides_is_clean():
+    res = proj(
+        {
+            "pkg/worker.py": """
+            import threading
+
+            class Worker:
+                def __init__(self):
+                    self.lock = threading.Lock()
+                    self.value = 0
+                    self.thread = threading.Thread(target=self._work)
+
+                def start(self):
+                    self.thread.start()
+
+                def _work(self):
+                    with self.lock:
+                        self.value = 1
+
+                def read(self):
+                    with self.lock:
+                        return self.value
+            """
+        }
+    )
+    assert res.findings == [] and res.errors == []
+
+
+def test_r9_guarded_by_annotation_excuses_and_is_marked_used():
+    res = proj(
+        {
+            "pkg/worker.py": """
+            import threading
+
+            class Worker:
+                def __init__(self):
+                    self.lock = threading.Lock()
+                    self.value = 0  # photon: guarded-by[lock]
+                    self.thread = threading.Thread(target=self._work)
+
+                def start(self):
+                    self.thread.start()
+
+                def _work(self):
+                    self.value = 1
+
+                def read(self):
+                    return self.value
+            """
+        }
+    )
+    assert res.findings == [] and res.errors == []
+    assert res.used_annotations == {("pkg/worker.py", 7)}
+
+
+def test_r9_thread_confined_annotation_excuses():
+    src = dict(RACY_WORKER)
+    src["pkg/worker.py"] = src["pkg/worker.py"].replace(
+        "self.value = 0", "self.value = 0  # photon: thread-confined"
+    )
+    res = proj(src)
+    assert res.findings == [] and res.errors == []
+    assert len(res.used_annotations) == 1
+
+
+def test_r9_guarded_by_unknown_lock_is_an_error():
+    src = dict(RACY_WORKER)
+    src["pkg/worker.py"] = src["pkg/worker.py"].replace(
+        "self.value = 0", "self.value = 0  # photon: guarded-by[nope]"
+    )
+    res = proj(src)
+    assert any("names no lock attribute" in e for e in res.errors)
+
+
+def test_r9_unattached_annotation_is_an_error():
+    res = proj(
+        {
+            "pkg/mod.py": """
+            def f():
+                x = 1  # photon: thread-confined
+                return x
+            """
+        }
+    )
+    assert any("not attached" in e for e in res.errors)
+
+
+SVC = {
+    "pkg/svc.py": """
+    class Svc:
+        def __init__(self):
+            self.state = 0
+
+        def _poll(self):
+            self.state = 1
+
+        def read(self):
+            return self.state
+    """
+}
+
+
+def test_r9_thread_entrypoints_create_worker_roots():
+    # without configuration _poll has no callers and no spawn site, so it
+    # conservatively seeds the main context: no conflict
+    assert proj(SVC).findings == []
+    cfg = LintConfig(thread_entrypoints=("pkg/svc.py::Svc._poll",))
+    res = proj(SVC, config=cfg)
+    assert [f.rule for f in res.findings] == ["R9"]
+    assert "Svc.state" in res.findings[0].message
+
+
+def test_r9_unknown_thread_entrypoint_is_an_error():
+    cfg = LintConfig(thread_entrypoints=("pkg/svc.py::Nope.f",))
+    res = proj(SVC, config=cfg)
+    assert any("thread_entrypoints" in e for e in res.errors)
+
+
+def test_r9_submitted_callable_runs_in_pool_context():
+    res = proj(
+        {
+            "pkg/pool.py": """
+            from concurrent.futures import ThreadPoolExecutor
+
+            class Job:
+                def __init__(self, pool):
+                    self.progress = 0
+                    self.pool = pool
+
+                def start(self):
+                    self.pool.submit(self._run)
+
+                def _run(self):
+                    self.progress = 1
+
+                def read(self):
+                    return self.progress
+            """
+        }
+    )
+    assert [f.rule for f in res.findings] == ["R9"]
+
+
+# ------------------------------------------- R10 (refusal-ledger contract)
+
+
+LEDGER_HEADER = (
+    "| refused combination | message contains | raised at |\n"
+    "|---|---|---|\n"
+)
+
+R10_MODULE = '''
+def solve(mode):
+    if mode == "box":
+        raise ValueError(
+            "lbfgs with box constraints is not supported; use tron"
+        )
+'''
+
+R10_PINS = """
+CASES = [
+    ("lbfgs-box", "lbfgs with box constraints is not supported", ValueError),
+]
+"""
+
+
+def _r10_repo(
+    tmp_path,
+    ledger_rows="| lbfgs + box | `lbfgs with box constraints is not supported` | `pkg/mod.py` |\n",
+    pins=R10_PINS,
+    module_src=R10_MODULE,
+):
+    (tmp_path / "README.md").write_text(LEDGER_HEADER + ledger_rows)
+    (tmp_path / "tests").mkdir(exist_ok=True)
+    (tmp_path / "tests" / "test_support_matrix.py").write_text(pins)
+    pkg = tmp_path / "pkg"
+    pkg.mkdir(exist_ok=True)
+    (pkg / "mod.py").write_text(textwrap.dedent(module_src))
+    cfg = LintConfig(paths=("pkg",), root=str(tmp_path))
+    sources = {"pkg/mod.py": textwrap.dedent(module_src)}
+    return cfg, sources
+
+
+def _r10(cfg, sources):
+    return analyze_project(sources, cfg, rules=("R10",))
+
+
+def test_r10_consistent_repo_is_clean(tmp_path):
+    cfg, sources = _r10_repo(tmp_path)
+    path, n = write_refusal_inventory(cfg)
+    assert n == 1 and os.path.isfile(path)
+    res = _r10(cfg, sources)
+    assert res.findings == []
+    assert res.refusal_inventory["refusals"][0]["modules"] == ["pkg/mod.py"]
+    assert res.refusal_inventory["refusals"][0]["exceptions"] == ["ValueError"]
+
+
+def test_r10_ledger_fragment_with_no_raise_site(tmp_path):
+    cfg, sources = _r10_repo(
+        tmp_path,
+        ledger_rows=(
+            "| lbfgs + box | `lbfgs with box constraints is not supported` | `pkg/mod.py` |\n"
+            "| ghost | `this refusal is enforced nowhere` | `pkg/mod.py` |\n"
+        ),
+    )
+    write_refusal_inventory(cfg)
+    msgs = [f.message for f in _r10(cfg, sources).findings]
+    assert any("matches no raise site" in m for m in msgs)
+    # the unenforced row is also unpinned
+    assert any("pinned by no" in m for m in msgs)
+
+
+def test_r10_pin_with_no_ledger_row(tmp_path):
+    cfg, sources = _r10_repo(
+        tmp_path,
+        pins=R10_PINS.replace(
+            "]\n",
+            '    ("ghost", "pinned but undocumented", ValueError),\n]\n',
+        ),
+    )
+    write_refusal_inventory(cfg)
+    res = _r10(cfg, sources)
+    assert [f.file for f in res.findings] == ["tests/test_support_matrix.py"]
+    assert "appears in no refusal-ledger row" in res.findings[0].message
+
+
+def test_r10_refusal_phrased_raise_must_be_documented(tmp_path):
+    cfg, sources = _r10_repo(
+        tmp_path,
+        module_src=R10_MODULE
+        + '''
+
+def other(layout):
+    if layout == "tiled":
+        raise ValueError(f"layout {layout} is not supported here")
+''',
+    )
+    write_refusal_inventory(cfg)
+    res = _r10(cfg, sources)
+    assert len(res.findings) == 1
+    f = res.findings[0]
+    assert f.file == "pkg/mod.py"
+    assert "matches no refusal-ledger row" in f.message
+
+
+def test_r10_inventory_staleness_is_byte_exact(tmp_path):
+    cfg, sources = _r10_repo(tmp_path)
+    msgs = [f.message for f in _r10(cfg, sources).findings]
+    assert any("refusal inventory is missing" in m for m in msgs)
+    path, _ = write_refusal_inventory(cfg)
+    assert _r10(cfg, sources).findings == []
+    with open(path, "a") as f:
+        f.write("\n")
+    msgs = [f.message for f in _r10(cfg, sources).findings]
+    assert any("refusal inventory is stale" in m for m in msgs)
+
+
+def test_r10_skipped_without_a_ledger(tmp_path):
+    cfg = LintConfig(paths=("pkg",), root=str(tmp_path))
+    res = analyze_project(
+        {"pkg/mod.py": textwrap.dedent(R10_MODULE)}, cfg, rules=("R10",)
+    )
+    assert res.findings == [] and res.refusal_inventory is None
+
+
+def test_fragment_matches_template():
+    segs = ["solver ", None, " does not support box constraints"]
+    assert fragment_matches_template("does not support box", segs)
+    assert fragment_matches_template("solver", segs)
+    # a match may span a placeholder when anchored in a literal
+    assert fragment_matches_template("solver lbfgs does not support", segs)
+    assert not fragment_matches_template("zzz", segs)
+    # a match living entirely inside a placeholder is vacuous
+    assert not fragment_matches_template("anything", ["prefix ", None])
+    # divergence inside a literal is a non-match
+    assert not fragment_matches_template("does not support ropes", segs)
+
+
+# ------------------------------------------------ R11 (metric contract)
+
+
+def _r11(src, config=None):
+    cfg = config or LintConfig(root="/nonexistent", metric_docs=())
+    res = analyze_project(
+        {"pkg/m.py": textwrap.dedent(src)}, cfg, rules=("R11",)
+    )
+    return res.findings
+
+
+def test_r11_counter_must_end_total():
+    fs = _r11(
+        """
+        def f(reg):
+            reg.counter("photon_foo", "help").inc()
+        """
+    )
+    assert ["must end in _total" in f.message for f in fs] == [True]
+
+
+def test_r11_non_counter_must_not_end_total():
+    fs = _r11(
+        """
+        def f(reg):
+            reg.gauge("photon_bar_total", "help").set(1)
+        """
+    )
+    assert ["must not end in _total" in f.message for f in fs] == [True]
+
+
+def test_r11_one_kind_per_family():
+    fs = _r11(
+        """
+        def f(reg):
+            reg.counter("photon_x_total", "help").inc()
+
+        def g(reg):
+            reg.gauge("photon_x_total", "help").set(1)
+        """
+    )
+    assert any("one family, one kind" in f.message for f in fs)
+
+
+def test_r11_label_sets_must_agree():
+    fs = _r11(
+        """
+        def f(reg):
+            reg.counter("photon_x_total", "help").labels(site=1).inc()
+
+        def g(reg):
+            reg.counter("photon_x_total", "help").labels(kind=2).inc()
+        """
+    )
+    assert any("label keys must agree" in f.message for f in fs)
+
+
+def test_r11_reserved_suffixes_rejected():
+    fs = _r11(
+        """
+        def f(reg):
+            reg.gauge("photon_x_count", "help").set(1)
+        """
+    )
+    assert any("reserves" in f.message for f in fs)
+
+
+def test_r11_consistent_family_is_clean():
+    assert (
+        _r11(
+            """
+            def f(reg):
+                reg.counter("photon_x_total", "help").labels(site=1).inc()
+
+            def g(reg):
+                reg.counter("photon_x_total", "help").labels(site=2).inc(3)
+            """
+        )
+        == []
+    )
+
+
+def test_r11_doc_drift_both_directions(tmp_path):
+    (tmp_path / "METRICS.md").write_text(
+        "documented: photon_a_total and photon_c_total\n"
+    )
+    cfg = LintConfig(root=str(tmp_path), metric_docs=("METRICS.md",))
+    fs = _r11(
+        """
+        def f(reg):
+            reg.counter("photon_a_total", "help").inc()
+            reg.counter("photon_b_total", "help").inc()
+        """,
+        config=cfg,
+    )
+    msgs = [f.message for f in fs]
+    assert any(
+        "'photon_b_total' is not documented" in m for m in msgs
+    )
+    assert any(
+        "'photon_c_total' is registered nowhere" in m for m in msgs
+    )
+    assert not any("photon_a_total" in m for m in msgs)
+
+
+def test_r11_dynamic_prefix_families_match_docs_by_prefix(tmp_path):
+    src = """
+    def f(reg, direction):
+        reg.counter(
+            f"photon_dev_{direction}_bytes_total", "help"
+        ).labels(site="x").inc()
+    """
+    (tmp_path / "METRICS.md").write_text("photon_dev_fetch_bytes_total\n")
+    cfg = LintConfig(root=str(tmp_path), metric_docs=("METRICS.md",))
+    assert _r11(src, config=cfg) == []
+    # without a doc token under the prefix, the dynamic family is flagged
+    (tmp_path / "METRICS.md").write_text("no metrics here\n")
+    fs = _r11(src, config=cfg)
+    assert any("dynamically-named metric family" in f.message for f in fs)
+
+
+# --------------------------------------------- R12 (unused suppressions)
+
+
+def test_r12_unused_suppression_flagged_on_full_runs(tmp_path):
+    cfg = _mini_repo(tmp_path, "x = 1  # photon: ignore[R4]\n")
+    result = analyze_paths(config=cfg)  # configured run -> project passes
+    assert [f.rule for f in result.active] == ["R12"]
+    assert "suppresses no finding" in result.active[0].message
+    # explicit file-subset runs stay per-file: no R12 there
+    assert analyze_paths(paths=("pkg",), config=cfg).active == []
+
+
+def test_r12_used_suppression_is_not_flagged(tmp_path):
+    cfg = _mini_repo(
+        tmp_path,
+        BAD_MODULE.replace(
+            "except Exception:", "except Exception:  # photon: ignore[R4]"
+        ),
+    )
+    assert analyze_paths(config=cfg).active == []
+
+
+def test_r12_unused_annotation_flagged(tmp_path):
+    cfg = _mini_repo(
+        tmp_path,
+        textwrap.dedent(
+            """
+            import threading
+
+            class C:
+                def __init__(self):
+                    self.lock = threading.Lock()
+                    self.x = 0  # photon: thread-confined
+            """
+        ),
+    )
+    result = analyze_paths(config=cfg)
+    assert [f.rule for f in result.active] == ["R12"]
+    assert "annotation suppresses no R9 finding" in result.active[0].message
+
+
+# --------------------------------------------------- explain / schema
+
+
+def test_every_rule_has_an_explanation():
+    for rule in RULES:
+        text = explain_rule(rule)
+        assert rule in text
+        assert "bad" in text.lower() and "good" in text.lower()
+
+
+def test_cli_explain(capsys):
+    assert lint_main(["--explain", "R9"]) == 0
+    out = capsys.readouterr().out
+    assert "R9" in out and "guarded-by" in out
+
+
+def test_cli_json_carries_schema_version(tmp_path, capsys):
+    _mini_repo(tmp_path)
+    py = _write_pyproject(tmp_path)
+    assert lint_main(["--config", py, "--json"]) == 1
+    report = json.loads(capsys.readouterr().out)
+    assert report["schema_version"] == JSON_SCHEMA_VERSION
+
+
+def test_cli_write_refusal_inventory(tmp_path, capsys):
+    cfg, _ = _r10_repo(tmp_path)
+    py = tmp_path / "pyproject.toml"
+    py.write_text(
+        textwrap.dedent(
+            """
+            [tool.photon-lint]
+            paths = ["pkg"]
+            """
+        )
+    )
+    assert lint_main(["--config", str(py), "--write-refusal-inventory"]) == 0
+    out = capsys.readouterr().out
+    assert "wrote 1 refusal(s)" in out
+    inv = json.loads((tmp_path / "refusals.json").read_text())
+    assert inv["refusals"][0]["modules"] == ["pkg/mod.py"]
 
 
 # ------------------------------------------------------------ runtime guard

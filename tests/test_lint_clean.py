@@ -9,13 +9,37 @@ grandfathering) add it to lint_baseline.json via --write-baseline."""
 
 import os
 
+import pytest
+
 from photon_ml_tpu.analysis import analyze_paths, load_baseline, load_config
+from photon_ml_tpu.analysis.engine import iter_python_files
+from photon_ml_tpu.analysis.project import (
+    analyze_project,
+    render_refusal_inventory,
+)
 
 REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 
-def test_package_is_lint_clean():
-    config = load_config(pyproject=os.path.join(REPO_ROOT, "pyproject.toml"))
+@pytest.fixture(scope="module")
+def config():
+    return load_config(pyproject=os.path.join(REPO_ROOT, "pyproject.toml"))
+
+
+@pytest.fixture(scope="module")
+def package_sources(config):
+    root = os.path.abspath(config.root)
+    sources = {}
+    for path in iter_python_files(config.paths, config):
+        rel = os.path.relpath(path, root).replace(os.sep, "/")
+        with open(path, encoding="utf-8") as f:
+            sources[rel] = f.read()
+    return sources
+
+
+def test_package_is_lint_clean(config):
+    # analyze_paths with default paths is a FULL configured run, so this
+    # gate covers the whole-program passes (R9-R12) too, not just R1-R8
     baseline = load_baseline(config.baseline_path)
     result = analyze_paths(config=config, baseline=baseline)
     assert not result.parse_errors, result.parse_errors
@@ -24,3 +48,27 @@ def test_package_is_lint_clean():
         for f in result.active
     )
     assert result.files_scanned > 50  # the walk really covered the package
+
+
+def test_race_annotations_are_consulted(config, package_sources):
+    """The R9 pass really runs and really validates: no annotation errors,
+    and the package's guarded-by/thread-confined annotations are consumed
+    by actual race findings (an unused one would be R12 upstream)."""
+    res = analyze_project(package_sources, config, rules=("R9",))
+    assert res.errors == []
+    assert res.annotations, "expected race annotations in the package"
+    assert res.used_annotations, "annotations exist but excuse no race"
+
+
+def test_refusal_inventory_is_fresh(config, package_sources):
+    """refusals.json must be byte-identical to a fresh regeneration, and
+    every documented refusal must be enforced by at least one raise site."""
+    res = analyze_project(package_sources, config, rules=("R10",))
+    assert res.refusal_inventory is not None, "README ledger not found"
+    want = render_refusal_inventory(res.refusal_inventory)
+    inv_path = os.path.join(config.root, config.refusal_inventory)
+    with open(inv_path, encoding="utf-8") as f:
+        assert f.read() == want, "stale: run --write-refusal-inventory"
+    for entry in res.refusal_inventory["refusals"]:
+        assert entry["modules"], f"unenforced refusal: {entry['fragment']!r}"
+        assert entry["exceptions"], entry["fragment"]

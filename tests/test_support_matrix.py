@@ -462,6 +462,28 @@ def test_refusal_message_agrees_with_table(
         trigger(raw, **kwargs)
 
 
+def test_pins_are_exactly_the_refusal_inventory():
+    """The machine-readable contract (refusals.json, regenerated by
+    ``python -m photon_ml_tpu.analysis --write-refusal-inventory``) and the
+    CASES pins above must describe the same refusal set, both directions:
+    every pin backs an inventory entry with a matching exception type, and
+    every inventory entry is exercised by some pin."""
+    import json
+
+    inv = json.loads((ROOT / "refusals.json").read_text())
+    entries = inv["refusals"]
+    assert len(entries) == len(CASES)
+    for _id, fragment, exc, _trigger in CASES:
+        matching = [e for e in entries if fragment in e["fragment"]]
+        assert matching, f"pin not in refusals.json: {fragment!r}"
+        assert any(exc.__name__ in e["exceptions"] for e in matching), fragment
+        assert all(e["modules"] for e in matching), fragment
+    for entry in entries:
+        assert any(
+            c[1] in entry["fragment"] for c in CASES
+        ), f"inventory entry pinned by no case: {entry['fragment']!r}"
+
+
 def test_matrix_present_in_both_docs(readme_text, migration_text):
     for text, doc in ((readme_text, "README.md"), (migration_text, "MIGRATION.md")):
         assert "## Support matrix" in text, doc
