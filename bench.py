@@ -1469,6 +1469,117 @@ def bench_sweep(n=2_000, d_fixed=32, n_users=200, d_re=8, ks=(1, 4, 8), sweeps=2
     }
 
 
+def bench_retrain(n=6_000, d_fixed=32, n_users=300, d_re=8, n_days=4, sweeps=2):
+    """Continuous training (game/incremental.py): the day-chained warm-start
+    retrain vs the daily from-scratch alternative over the SAME feed.
+
+    The feed is one generated GLMix dataset split into ``n_days`` contiguous
+    day slices plus a held-out validation tail. The incremental leg runs
+    ``run_chain``: day k warm-starts from day k-1's accepted model
+    (prior-centered L2, only touched entities re-solved) and passes the
+    no-degrade gate on the validation tail. The scratch leg is what a daily
+    from-scratch retrain actually costs: day k refits the union of days
+    0..k from zero, then evaluates the same validation tail.
+
+    Headline: retrain_incremental_vs_scratch_wall_ratio — incremental chain
+    wall / scratch chain wall, LOWER is better (the --diff direction
+    self-check pins the 'wall' suffix). The incremental quadrant also
+    carries rows_touched_fraction (rows the chain trained on / rows the
+    scratch chain trained on; lower = more of the feed carried forward)."""
+    import tempfile
+
+    from photon_ml_tpu.estimators import CoordinateConfig, GameEstimator
+    from photon_ml_tpu.game import incremental
+    from photon_ml_tpu.game.problem import GLMOptimizationConfig
+    from photon_ml_tpu.ops.regularization import RegularizationContext
+    from photon_ml_tpu.optimize import OptimizerConfig
+    from photon_ml_tpu.testing import generate_mixed_effect_data
+    from photon_ml_tpu.testing.generators import mixed_data_to_raw_dataset
+
+    raw = mixed_data_to_raw_dataset(
+        generate_mixed_effect_data(
+            n=n, d_fixed=d_fixed, re_specs={"userId": (n_users, d_re)}, seed=11
+        )
+    )
+    rows = np.arange(n)
+    n_feed = int(n * 0.8)
+    validation = raw.subset(rows[n_feed:])
+    bounds = np.linspace(0, n_feed, n_days + 1).astype(int)
+    day_slices = [
+        raw.subset(rows[bounds[k]:bounds[k + 1]]) for k in range(n_days)
+    ]
+    days = [(f"202601{k + 1:02d}", d) for k, d in enumerate(day_slices)]
+
+    def configs():
+        opt = OptimizerConfig(tolerance=1e-7, max_iterations=50)
+        return [
+            CoordinateConfig(
+                name="global",
+                feature_shard="global",
+                config=GLMOptimizationConfig(
+                    optimizer=opt,
+                    regularization=RegularizationContext("L2"),
+                    reg_weight=1.0,
+                ),
+            ),
+            CoordinateConfig(
+                name="per-user",
+                feature_shard="userShard",
+                random_effect_type="userId",
+                config=GLMOptimizationConfig(
+                    optimizer=opt,
+                    regularization=RegularizationContext("L2"),
+                    reg_weight=1.0,
+                ),
+            ),
+        ]
+
+    def estimator():
+        return GameEstimator(
+            task="logistic_regression",
+            coordinate_configs=configs(),
+            n_cd_iterations=sweeps,
+            evaluator_specs=["AUC"],
+        )
+
+    with tempfile.TemporaryDirectory() as chain_dir:
+        t0 = time.perf_counter()
+        chained = incremental.run_chain(
+            estimator(), days, validation,
+            chain_dir=chain_dir, evaluator_specs=["AUC"], gate_margin=1.0,
+        )
+        wall_inc = time.perf_counter() - t0
+
+    t0 = time.perf_counter()
+    for k in range(n_days):
+        union = raw.subset(rows[: bounds[k + 1]])
+        estimator().fit(union, validation=validation)
+    wall_scratch = time.perf_counter() - t0
+
+    ratio = wall_inc / wall_scratch
+    return {
+        "metric": "retrain_incremental_vs_scratch_wall_ratio",
+        "value": round(ratio, 4),
+        "unit": (
+            f"incremental day-chain wall / daily from-scratch wall over "
+            f"{n_days} days (n={n} rows, d_fixed={d_fixed} + per-user GLMix, "
+            f"{sweeps} CD sweeps; scratch day k refits the union of days "
+            "0..k; LOWER is better). rows_touched_fraction = chain rows "
+            "trained on / scratch rows trained on"
+        ),
+        "vs_baseline": round(1.0 / ratio, 2),
+        "quadrants": {
+            "incremental": {
+                "wall_sec": round(wall_inc, 3),
+                "rows_touched_fraction": round(
+                    chained.rows_touched_fraction, 4
+                ),
+            },
+            "scratch": {"wall_sec": round(wall_scratch, 3)},
+        },
+    }
+
+
 def summary_metric(path: str) -> dict:
     """One bench-format JSON line from a cli.train run_summary.json (the
     --metrics-out telemetry), replacing the old stdout-scraping flow:
@@ -1552,6 +1663,9 @@ def _lower_is_better(name: str) -> bool:
         or "wall" in n
         or "p50" in n
         or "p99" in n
+        # rows_touched fraction: the incremental-retrain win is touching
+        # FEWER of the feed's rows per day (more carried forward bitwise)
+        or "rows_touched" in n
     )
 
 
@@ -1574,7 +1688,10 @@ def _diff_one(name: str, old_v: float, new_v: float, tolerance: float) -> dict:
             f"--diff direction check: series {name!r} must be "
             "higher-is-better"
         )
-    if ("p99" in nl or nl.endswith("_ms")) and not lower_better:
+    if (
+        "p99" in nl or nl.endswith("_ms") or "rows_touched" in nl
+        or ("wall" in nl and "per_sec" not in nl)
+    ) and not lower_better:
         raise AssertionError(
             f"--diff direction check: series {name!r} must be "
             "lower-is-better"
@@ -1668,6 +1785,7 @@ def main(argv: Optional[List[str]] = None):
         choices=[
             "glmix", "sparse", "billion", "tiled", "hbm", "streamed-fe",
             "serving", "serving-openloop", "multichip", "ingest", "sweep",
+            "retrain",
         ],
         default="glmix",
     )
@@ -1797,6 +1915,9 @@ def main(argv: Optional[List[str]] = None):
         return
     if a.config == "sweep":
         print(json.dumps(bench_sweep()))
+        return
+    if a.config == "retrain":
+        print(json.dumps(bench_retrain()))
         return
 
     n = a.n

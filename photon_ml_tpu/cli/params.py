@@ -254,6 +254,39 @@ def check_pipeline_composition(depth: int, distributed: bool) -> None:
         )
 
 
+def check_retrain_composition(
+    distributed: bool, trial_lanes: int, streamed_coordinates=()
+) -> None:
+    """Refuse the illegal incremental-retrain compositions up front, in one
+    place (support-matrix ledger). The day chain is a local control loop: it
+    loads/merges host-resident models, appends a durable ledger, and flips a
+    local serving store — none of which is collective-aware; trial lanes are
+    already refused with regularize-by-prior (the warm-start mechanism the
+    chain is built on); streamed coordinates never materialize the
+    host-resident models the per-day entity merge carries forward."""
+    if distributed:
+        raise ValueError(
+            "incremental retrain is single-process: not composable with "
+            "--distributed (the day chain's ledger, model merge and serving "
+            "publish are host-local; shard the feed by day across hosts "
+            "instead)"
+        )
+    if trial_lanes and trial_lanes > 1:
+        raise ValueError(
+            "incremental retrain warm-starts with regularize-by-prior: not "
+            "composable with --trial-lanes (the lane solver has no per-lane "
+            "prior operand)"
+        )
+    streamed = [str(c) for c in streamed_coordinates if c]
+    if streamed:
+        raise ValueError(
+            "incremental retrain requires HBM-resident coordinates: not "
+            "composable with hbm.budget.mb streaming (the per-day entity "
+            f"merge carries host-resident models forward) — remove "
+            f"hbm.budget.mb from {sorted(streamed)}"
+        )
+
+
 def build_shard_configs(args) -> Dict[str, FeatureShardConfig]:
     shards: Dict[str, FeatureShardConfig] = {}
     for spec in args.feature_shard:

@@ -542,6 +542,20 @@ def _run_training(args, run_t, metric_sinks, t_run0, recorder=None) -> Dict:
 
     initial_model = None
     if args.model_input_dir:
+        if args.incremental_training:
+            # prior-compatibility check BEFORE the load: load_game_model keys
+            # coefficients off (name, term) and silently drops features the
+            # current index cannot host — acceptable for plain warm-start
+            # initialization, fatal for priors (a dropped feature re-centers
+            # its prior at zero without saying so). Indices that merely
+            # permuted remap losslessly; missing features are refused.
+            from ..io.model_io import check_prior_compatibility
+
+            compat = check_prior_compatibility(args.model_input_dir, index_maps)
+            logger.info(
+                "incremental prior feature-index compatibility: %s",
+                ", ".join(f"{s}={v}" for s, v in sorted(compat.items())),
+            )
         initial_model = load_game_model(args.model_input_dir, index_maps, task=args.task)
     if args.incremental_training:
         if initial_model is None:

@@ -19,6 +19,7 @@ IndexMap, so models interoperate with Photon ML deployments.
 
 from __future__ import annotations
 
+import hashlib
 import json
 import os
 from typing import Dict, Mapping, Optional, Tuple
@@ -125,6 +126,118 @@ def load_glm(path: str, index_map: IndexMap, task: Optional[str] = None):
     return model_for_task(task, coef)
 
 
+def index_fingerprint(index_maps: Mapping[str, IndexMap]) -> dict:
+    """Per-shard digests of the (feature key -> index) bijection, stamped
+    into ``model-metadata.json`` at save time. ``keys`` digests the key SET
+    (order-independent) — two indices with equal ``keys`` but different
+    ``layout`` hold the same features at permuted positions, which the
+    (name, term)-keyed load remaps losslessly. A ``layout`` match means the
+    index is bitwise-identical, so warm-start priors align with no scan."""
+    shards = {}
+    for shard in sorted(index_maps):
+        imap = index_maps[shard]
+        h_keys = hashlib.sha256()
+        h_layout = hashlib.sha256()
+        for key, idx in sorted(imap.items()):
+            kb = key.encode("utf-8")
+            h_keys.update(kb)
+            h_keys.update(b"\x00")
+            h_layout.update(kb)
+            h_layout.update(f":{idx}\x00".encode("utf-8"))
+        shards[shard] = {
+            "size": len(imap),
+            "keys": h_keys.hexdigest(),
+            "layout": h_layout.hexdigest(),
+        }
+    return {"version": 1, "shards": shards}
+
+
+def _iter_model_coefficient_dirs(model_dir: str):
+    """Yield (coordinate, feature_shard, coefficients_dir) for every
+    sub-model in the reference layout."""
+    for kind in ("fixed-effect", "random-effect"):
+        root = os.path.join(model_dir, kind)
+        if not os.path.isdir(root):
+            continue
+        for name in sorted(os.listdir(root)):
+            base = os.path.join(root, name)
+            if not os.path.isdir(base):
+                continue
+            with open(os.path.join(base, "id-info")) as f:
+                first = f.readline().strip()
+                shard = f.readline().strip() if kind == "random-effect" else first
+            yield name, shard, os.path.join(base, "coefficients")
+
+
+def check_prior_compatibility(
+    model_dir: str, index_maps: Mapping[str, IndexMap]
+) -> Dict[str, str]:
+    """Verify a warm-start prior's feature space against the current index
+    before ``--incremental-training`` loads it.
+
+    Returns ``{shard: "exact" | "remap"}``. ``exact``: the stored
+    fingerprint matches the current index bitwise. ``remap``: the indices
+    differ but every prior feature exists under the current index, so the
+    (name, term)-keyed load relocates each coefficient correctly. Any prior
+    feature MISSING from the current index is refused with a typed error —
+    ``load_game_model`` would silently drop those coefficients, mis-centering
+    the prior instead of failing."""
+    meta_path = os.path.join(model_dir, "model-metadata.json")
+    stored = {}
+    if os.path.exists(meta_path):
+        with open(meta_path) as f:
+            stored = (json.load(f).get("featureIndexFingerprint") or {}).get(
+                "shards", {}
+            )
+    current = index_fingerprint(index_maps)["shards"]
+
+    verdict: Dict[str, str] = {}
+    scan_shards = set()
+    for name, shard, coef_dir in _iter_model_coefficient_dirs(model_dir):
+        if shard in verdict or shard in scan_shards:
+            continue
+        if shard not in index_maps:
+            raise ValueError(
+                "--incremental-training refused: prior model features absent "
+                f"from the current feature index (shard {shard!r} of "
+                f"{model_dir} has no current index at all); rebuild the "
+                "feature index to cover the prior model"
+            )
+        got, want = stored.get(shard), current.get(shard)
+        if got and want and got.get("layout") == want.get("layout"):
+            verdict[shard] = "exact"
+        elif got and want and got.get("keys") == want.get("keys"):
+            verdict[shard] = "remap"
+        else:
+            scan_shards.add(shard)
+
+    # no (or mismatched) fingerprint: scan the coefficient triples themselves
+    for name, shard, coef_dir in _iter_model_coefficient_dirs(model_dir):
+        if shard not in scan_shards:
+            continue
+        imap = index_maps[shard]
+        missing = 0
+        example = None
+        for rec in iter_avro_directory(coef_dir):
+            for part in ("means", "variances"):
+                for t in rec.get(part) or ():
+                    key = feature_key(t["name"], t["term"])
+                    if key not in imap:
+                        missing += 1
+                        example = example or key
+        if missing:
+            raise ValueError(
+                "--incremental-training refused: prior model features absent "
+                f"from the current feature index ({missing} coefficient(s) of "
+                f"shard {shard!r}, e.g. {example!r}); a silent load would "
+                "mis-align the warm-start priors — rebuild the feature index "
+                "to cover the prior model"
+            )
+        verdict[shard] = "remap"
+        scan_shards.discard(shard)
+    return verdict
+
+
 def save_game_model(
     model_dir: str,
     game_model: GameModel,
@@ -134,7 +247,11 @@ def save_game_model(
     records_per_file: int = 100_000,
 ):
     os.makedirs(model_dir, exist_ok=True)
-    meta = {"modelType": game_model.task.upper(), **(metadata or {})}
+    meta = {
+        "modelType": game_model.task.upper(),
+        "featureIndexFingerprint": index_fingerprint(index_maps),
+        **(metadata or {}),
+    }
     # every file in the layout lands atomically (temp+fsync+rename,
     # robust.atomic) and retries transient failures at site io.model_save: a
     # crashed/flaky save never leaves a torn file a later load half-reads

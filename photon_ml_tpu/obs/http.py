@@ -106,6 +106,26 @@ def compose_statusz(
     if stream:
         doc["stream"] = stream
 
+    retrain: dict = {}
+    days_by_outcome = _sum_counter(snap, "photon_retrain_days_total", "outcome")
+    if days_by_outcome:
+        retrain["days_total"] = int(sum(days_by_outcome.values()))
+        retrain["days_by_outcome"] = {
+            k: int(v) for k, v in days_by_outcome.items()
+        }
+        rejected = _sum_counter(snap, "photon_retrain_rejected_total", "reason")
+        if rejected:
+            retrain["rejected_by_reason"] = {
+                k: int(v) for k, v in rejected.items()
+            }
+        published = _sum_counter(snap, "photon_retrain_published_total")
+        retrain["published_total"] = int(published)
+        day_index = _gauge_value(snap, "photon_retrain_day_index")
+        if day_index is not None:
+            retrain["day_index"] = int(day_index)
+    if retrain:
+        doc["retrain"] = retrain
+
     serving: dict = {}
     requests = _sum_counter(snap, "photon_serving_requests_total")
     offered = _sum_counter(snap, "photon_serving_offered_total")

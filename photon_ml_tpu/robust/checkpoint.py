@@ -112,6 +112,7 @@ class CheckpointManager:
         keep_last: int = 3,
         every: int = 1,
         fsync: bool = True,
+        base_meta: Optional[dict] = None,
     ):
         if keep_last < 1:
             raise ValueError(f"keep_last must be >= 1: {keep_last}")
@@ -121,6 +122,11 @@ class CheckpointManager:
         self.keep_last = keep_last
         self.every = every
         self.fsync = fsync
+        # merged into every manifest this manager writes (per-save meta wins
+        # on key collisions): the retrain chain stamps its day index and the
+        # accepted/rejected ledger here, so any boundary checkpoint alone
+        # identifies its position in the day chain
+        self.base_meta = dict(base_meta or {})
         self._boundaries = 0
         os.makedirs(directory, exist_ok=True)
         steps = self._steps_on_disk()
@@ -185,6 +191,7 @@ class CheckpointManager:
             "sha256": digest,
             "bytes": len(blob),
             "created_unix": time.time(),
+            **self.base_meta,
             **(meta or {}),
         }
         io_call(

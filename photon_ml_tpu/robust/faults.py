@@ -53,6 +53,14 @@ rejection) without contriving pathological input data.
 ``solver.value_and_grad:nan:3`` corrupts the effective offsets of the third
 host-level coordinate solve; ``coordinate.scores:nan:p0.3`` corrupts each
 coordinate's freshly computed scores with probability 0.3.
+
+The continuous-training chain (``game/incremental.py``) adds two sites:
+``retrain.day`` fires once per chain day before any of its work
+(``retrain.day:kill:2`` is the crash-between-days drill — the ledger
+resumes), and ``retrain.publish`` fires immediately before a snapshot
+publication (``retrain.publish:io:1`` is the torn-publish drill — the gate
+decision is already durable in the ledger, the previous snapshot keeps
+serving, and the next cycle repairs the store).
 """
 
 from __future__ import annotations

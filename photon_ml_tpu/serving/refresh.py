@@ -47,15 +47,32 @@ def publish_snapshot(
     model_dir: Optional[str] = None,
     index_maps: Optional[Mapping[str, object]] = None,
     task: Optional[str] = None,
+    replace: bool = False,
 ) -> str:
     """Build ``name`` from either an in-memory GameModel or an Avro model
-    directory, publish it atomically, and point ``CURRENT`` at it."""
+    directory, publish it atomically, and point ``CURRENT`` at it.
+
+    ``replace=True`` is the torn-publish repair mode (the retrain chain's
+    next cycle): a stale half-built ``.tmp-<name>`` from a crashed publish
+    is discarded, and a ``name`` that already finished publishing is reused
+    as-is — only ``CURRENT`` is re-pointed. Without it a completed snapshot
+    name is refused (snapshots are immutable once published)."""
     if (game_model is None) == (model_dir is None):
         raise ValueError("pass exactly one of game_model / model_dir")
     final = snapshot_path(serving_root, name)
-    if os.path.exists(final):
-        raise FileExistsError(f"snapshot already published: {final}")
     tmp = os.path.join(serving_root, SNAPSHOT_DIR, f".tmp-{name}")
+    if os.path.exists(final):
+        if not replace:
+            raise FileExistsError(f"snapshot already published: {final}")
+        # the store build committed; a retry only needs the pointer flip
+        atomic_write_text(
+            os.path.join(serving_root, CURRENT_POINTER), name + "\n"
+        )
+        return final
+    if replace and os.path.exists(tmp):
+        import shutil
+
+        shutil.rmtree(tmp)  # half-built leftover of a torn publish
     os.makedirs(os.path.dirname(final), exist_ok=True)
     if game_model is not None:
         build_store_from_model(game_model, tmp)

@@ -609,3 +609,148 @@ def test_full_variance_on_tiled_works_and_ceiling_fails_early(avro_paths, tmp_pa
     with pytest.raises(ValueError, match="variance=FULL"):
         prob.run(tb)
 
+
+
+@pytest.fixture(scope="module")
+def retrain_feed(tmp_path_factory):
+    """A day-partitioned feed (<base>/yyyy/MM/dd, with one missing day in the
+    range) plus a union file for index building and held-out validation from
+    the SAME generating model."""
+    d = tmp_path_factory.mktemp("retrainfeed")
+    data = generate_mixed_effect_data(
+        n=900, d_fixed=5, re_specs={"userId": (15, 3)}, seed=31
+    )
+    recs = generate_game_records(data)
+    schema = {
+        **TRAINING_EXAMPLE_AVRO,
+        "fields": TRAINING_EXAMPLE_AVRO["fields"]
+        + [
+            {
+                "name": "userFeatures",
+                "type": {"type": "array", "items": "FeatureAvro"},
+                "default": [],
+            }
+        ],
+    }
+    base = d / "feed"
+    for rel, rr in [
+        ("2026/01/01", recs[:250]),
+        ("2026/01/02", recs[250:500]),
+        ("2026/01/04", recs[500:700]),  # 2026/01/03 intentionally absent
+    ]:
+        day_dir = base / rel
+        day_dir.mkdir(parents=True)
+        write_avro_file(str(day_dir / "part-00000.avro"), schema, rr)
+    union_p = str(d / "union.avro")
+    write_avro_file(union_p, schema, recs[:700])
+    val_p = str(d / "val.avro")
+    write_avro_file(val_p, schema, recs[700:])
+    return str(base), union_p, val_p
+
+
+def _retrain_args(base, idx, val_p, out, srv, extra=()):
+    return [
+        "--input-data", base,
+        "--input-data-date-range", "20260101-20260104",
+        "--validation-data", val_p,
+        "--feature-index-dir", idx,
+        "--task", "logistic_regression",
+        "--feature-shard", "name=globalShard,bags=features",
+        "--feature-shard", "name=userShard,bags=userFeatures",
+        "--coordinate",
+        "name=global,shard=globalShard,optimizer=LBFGS,tolerance=1e-7,"
+        "max.iter=100,reg.type=L2,reg.weights=1",
+        "--coordinate",
+        "name=per-user,shard=userShard,re.type=userId,reg.type=L2,reg.weights=1",
+        "--coordinate-descent-iterations", "2",
+        "--evaluators", "AUC",
+        "--gate-margin", "0.05",
+        "--output-dir", out,
+        "--serving-root", srv,
+        *extra,
+    ]
+
+
+def test_retrain_cli_day_chain_end_to_end(retrain_feed, tmp_path):
+    from photon_ml_tpu.cli import retrain
+    from photon_ml_tpu.serving import refresh
+
+    base, union_p, val_p = retrain_feed
+    idx = str(tmp_path / "index")
+    index.run(
+        [
+            "--input-data", union_p,
+            "--feature-shard", "name=globalShard,bags=features",
+            "--feature-shard", "name=userShard,bags=userFeatures",
+            "--output-dir", idx,
+            "--num-partitions", "2",
+        ]
+    )
+    out = str(tmp_path / "chain")
+    srv = str(tmp_path / "serving")
+    argv = _retrain_args(base, idx, val_p, out, srv)
+
+    summary = retrain.run(argv)
+    # the missing 20260103 day dir is skipped, not an error
+    assert [d["day"] for d in summary["days"]] == [
+        "20260101", "20260102", "20260104",
+    ]
+    assert summary["accepted_days"] >= 1
+    assert 0.0 < summary["rows_touched_fraction"] <= 1.0
+    assert os.path.exists(os.path.join(out, "retrain-summary.json"))
+    # the last accepted day's snapshot is what a live `cli serve` would flip to
+    published = [d for d in summary["days"] if d["published"]]
+    assert published
+    assert refresh.current_snapshot(srv) == f"retrain-{published[-1]['day']}"
+
+    # rerun is a resume: decided days are skipped, the ledger is unchanged
+    summary2 = retrain.run(argv)
+    assert summary2["days"] == summary["days"]
+
+
+def test_retrain_cli_refusals(retrain_feed, tmp_path):
+    from photon_ml_tpu.cli import retrain
+
+    base, _, val_p = retrain_feed
+    out = str(tmp_path / "chain")
+    # no --feature-index-dir: the chain's feature space must be pinned
+    with pytest.raises(SystemExit, match="feature-index-dir"):
+        retrain.run(
+            [
+                "--input-data", base,
+                "--input-data-date-range", "20260101-20260104",
+                "--validation-data", val_p,
+                "--output-dir", out,
+            ]
+        )
+    # no day range at all: retrain only walks day-partitioned feeds
+    with pytest.raises(SystemExit, match="day-partitioned feed"):
+        retrain.run(
+            [
+                "--input-data", base,
+                "--validation-data", val_p,
+                "--feature-index-dir", str(tmp_path / "idx"),
+                "--output-dir", out,
+            ]
+        )
+    # illegal compositions are typed refusals, not crashes mid-chain
+    common = [
+        "--input-data", base,
+        "--input-data-date-range", "20260101-20260104",
+        "--validation-data", val_p,
+        "--feature-index-dir", str(tmp_path / "idx"),
+        "--output-dir", out,
+    ]
+    with pytest.raises(ValueError, match="not composable with --distributed"):
+        retrain.run(common + ["--distributed", "coordinator=127.0.0.1:9000"])
+    with pytest.raises(ValueError, match="not composable with --trial-lanes"):
+        retrain.run(common + ["--trial-lanes", "4"])
+    with pytest.raises(ValueError, match="hbm.budget.mb streaming"):
+        retrain.run(
+            common
+            + [
+                "--coordinate",
+                "name=global,shard=globalShard,reg.type=L2,reg.weights=1,"
+                "hbm.budget.mb=64",
+            ]
+        )

@@ -1,0 +1,562 @@
+"""Continuous-training chain tests (game/incremental.py + cli/retrain.py):
+entity-merge bitwise carry, chain-vs-scratch equivalence, the no-degrade
+promotion gate, kill/resume and torn-publish drills, prior-index
+compatibility, and the day-partitioned CLI driver end to end."""
+
+from __future__ import annotations
+
+import json
+import os
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from photon_ml_tpu import obs
+from photon_ml_tpu.estimators.game_estimator import (
+    CoordinateConfig,
+    GameEstimator,
+    GameTransformer,
+)
+from photon_ml_tpu.game import incremental
+from photon_ml_tpu.game.problem import GLMOptimizationConfig
+from photon_ml_tpu.io.index_map import IndexMap, feature_key
+from photon_ml_tpu.io.model_io import (
+    check_prior_compatibility,
+    load_game_model,
+    save_game_model,
+)
+from photon_ml_tpu.models.game import (
+    FixedEffectModel,
+    GameModel,
+    RandomEffectModel,
+)
+from photon_ml_tpu.models.glm import Coefficients, LogisticRegressionModel
+from photon_ml_tpu.ops.regularization import RegularizationContext
+from photon_ml_tpu.optimize import OptimizerConfig
+from photon_ml_tpu.robust import faults
+from photon_ml_tpu.robust.faults import SimulatedKill
+from photon_ml_tpu.serving import refresh
+from photon_ml_tpu.testing import generate_mixed_effect_data
+from photon_ml_tpu.testing.generators import mixed_data_to_raw_dataset
+
+D_FIXED = 6
+N_ENT, D_RE = 10, 3
+SPECS = ["AUC", "AUC:userId"]
+
+
+@pytest.fixture
+def run():
+    r = obs.RunTelemetry()
+    with obs.use_run(r):
+        yield r
+
+
+def _cfg():
+    return GLMOptimizationConfig(
+        optimizer=OptimizerConfig(tolerance=1e-9, max_iterations=200),
+        regularization=RegularizationContext("L2"),
+        reg_weight=1.0,
+    )
+
+
+def _coords():
+    return [
+        CoordinateConfig(name="global", feature_shard="global", config=_cfg()),
+        CoordinateConfig(
+            name="per-user",
+            feature_shard="userShard",
+            random_effect_type="userId",
+            config=_cfg(),
+        ),
+    ]
+
+
+def _estimator(n_cd_iterations=2):
+    return GameEstimator(
+        task="logistic_regression",
+        coordinate_configs=_coords(),
+        n_cd_iterations=n_cd_iterations,
+        evaluator_specs=SPECS,
+        dtype=jnp.float64,
+    )
+
+
+def _index_maps():
+    # add_intercept=False: the generated dense features have no intercept
+    # column, so the index dim must equal d_fixed for save/load round trips
+    return {
+        "global": IndexMap.from_name_terms(
+            [(f"g{j}", "") for j in range(D_FIXED)], add_intercept=False
+        ),
+        "userShard": IndexMap.from_name_terms(
+            [(f"u{j}", "") for j in range(D_RE)], add_intercept=False
+        ),
+    }
+
+
+@pytest.fixture(scope="module")
+def feed():
+    """Three 'days' of GLMix rows + a held-out validation set. Day 2 only
+    touches the first half of the entities, so the second half must carry
+    forward bitwise through the merge."""
+    data = generate_mixed_effect_data(
+        task="logistic_regression",
+        n=1100,
+        d_fixed=D_FIXED,
+        re_specs={"userId": (N_ENT, D_RE)},
+        seed=7,
+    )
+    raw = mixed_data_to_raw_dataset(data)
+    # one generating model; held-out validation rows come from the SAME
+    # model so the learned effects actually transfer (test_estimators idiom)
+    ents = data.entity_ids["userId"]
+    first_half = np.isin(ents, [f"e{k}" for k in range(N_ENT // 2)])
+    rows = np.arange(data.n)
+    in_day1 = (rows >= 350) & (rows < 700)
+    day0 = raw.subset(rows[:350])
+    day1 = raw.subset(rows[in_day1 & first_half])
+    day2 = raw.subset(rows[in_day1 & ~first_half])
+    return {
+        "union": raw.subset(rows[:700]),
+        "days": [("20260101", day0), ("20260102", day1), ("20260103", day2)],
+        "validation": raw.subset(rows[700:]),
+    }
+
+
+# -- the entity merge --------------------------------------------------------
+
+
+def _re(ids, idx, val, variances=None):
+    return RandomEffectModel(
+        random_effect_type="userId",
+        feature_shard="userShard",
+        task="logistic_regression",
+        entity_ids=np.asarray(ids, dtype=object),
+        coef_indices=jnp.asarray(idx, jnp.int32),
+        coef_values=jnp.asarray(val, jnp.float64),
+        variances=None if variances is None else jnp.asarray(variances, jnp.float64),
+    )
+
+
+def test_grow_random_effect_bitwise_carry_and_growth():
+    prior = _re(["uA", "uB"], [[0, 1], [2, -1]], [[0.5, -1.5], [2.25, 0.0]])
+    update = _re(["uB", "uD"], [[0, 1, 2], [1, -1, -1]], [[9.0, 8.0, 7.0], [3.5, 0.0, 0.0]])
+    out = incremental.grow_random_effect(prior, update)
+    assert list(out.entity_ids) == ["uA", "uB", "uD"]
+    # uA untouched: bitwise carry, widened with the -1 sentinel / 0.0 pad
+    np.testing.assert_array_equal(np.asarray(out.coef_indices[0]), [0, 1, -1])
+    np.testing.assert_array_equal(np.asarray(out.coef_values[0]), [0.5, -1.5, 0.0])
+    # uB re-solved in place, uD appended (model growth)
+    np.testing.assert_array_equal(np.asarray(out.coef_indices[1]), [0, 1, 2])
+    np.testing.assert_array_equal(np.asarray(out.coef_values[1]), [9.0, 8.0, 7.0])
+    np.testing.assert_array_equal(np.asarray(out.coef_values[2]), [3.5, 0.0, 0.0])
+    assert out.variances is None
+    # untouched entities score identically through the merged model
+    assert out.entity_row("uA") == 0 and out.entity_row("uD") == 2
+
+
+def test_grow_random_effect_variances_merge_only_when_both_sides_have_them():
+    prior = _re(["uA"], [[0]], [[1.0]], variances=[[0.25]])
+    update = _re(["uB"], [[1]], [[2.0]], variances=[[0.5]])
+    both = incremental.grow_random_effect(prior, update)
+    np.testing.assert_array_equal(np.asarray(both.variances), [[0.25], [0.5]])
+    # a means-only update invalidates the prior's stale variances
+    mixed = incremental.grow_random_effect(prior, _re(["uB"], [[1]], [[2.0]]))
+    assert mixed.variances is None
+
+
+def test_grow_random_effect_refuses_mismatched_models():
+    prior = _re(["uA"], [[0]], [[1.0]])
+    other = RandomEffectModel(
+        random_effect_type="itemId",
+        feature_shard="userShard",
+        task="logistic_regression",
+        entity_ids=np.asarray(["i1"], dtype=object),
+        coef_indices=jnp.asarray([[0]], jnp.int32),
+        coef_values=jnp.asarray([[1.0]], jnp.float64),
+    )
+    with pytest.raises(ValueError, match="different types"):
+        incremental.grow_random_effect(prior, other)
+
+
+def test_merge_models_counts_touched_entities():
+    fe = FixedEffectModel(
+        model=LogisticRegressionModel(Coefficients(jnp.zeros(3))),
+        feature_shard="global",
+    )
+    prior = GameModel(
+        models={"global": fe, "per-user": _re(["uA", "uB"], [[0], [1]], [[1.0], [2.0]])},
+        task="logistic_regression",
+    )
+    update = GameModel(
+        models={"global": fe, "per-user": _re(["uB", "uC"], [[0], [1]], [[5.0], [6.0]])},
+        task="logistic_regression",
+    )
+    merged, touched = incremental.merge_models(prior, update)
+    assert touched == {"per-user": 2}
+    assert merged.models["per-user"].num_entities == 3
+    # no prior: the whole update counts as touched
+    _, touched0 = incremental.merge_models(None, update)
+    assert touched0 == {"per-user": 2}
+
+
+# -- the chain ---------------------------------------------------------------
+
+
+def _auc(model, validation):
+    _, ev = GameTransformer(model=model, dtype=jnp.float64).transform(
+        validation, ["AUC"]
+    )
+    return ev.metrics["AUC"]
+
+
+def test_chain_matches_scratch_union_and_touches_fraction(feed, run, tmp_path):
+    res = incremental.run_chain(
+        _estimator(),
+        feed["days"],
+        feed["validation"],
+        chain_dir=str(tmp_path / "chain"),
+        evaluator_specs=SPECS,
+        # entity-partitioned day slices are FE-biased by construction; a
+        # small margin lets the chain advance through the tiny dips
+        gate_margin=0.05,
+        dtype=jnp.float64,
+    )
+    assert [r.accepted for r in res.ledger].count(True) == 3
+    # a daily from-scratch retrain refits the whole union every day; the
+    # chain touches only each day's rows
+    assert res.rows_touched < res.rows_cumulative
+    assert res.rows_touched_fraction == pytest.approx(
+        res.rows_touched / res.rows_cumulative
+    )
+    scratch = _estimator().fit(feed["union"])
+    scratch_auc = _auc(scratch[-1].model, feed["validation"])
+    chain_auc = _auc(res.model, feed["validation"])
+    assert chain_auc > 0.6
+    assert abs(chain_auc - scratch_auc) < 0.05
+
+
+def test_chain_untouched_entities_carry_bitwise(feed, run, tmp_path):
+    days = feed["days"]
+    est = _estimator()
+    first = incremental.run_chain(
+        est, days[:1], feed["validation"], chain_dir=str(tmp_path / "a"),
+        evaluator_specs=SPECS, gate_margin=0.05, dtype=jnp.float64,
+    )
+    chained = incremental.run_chain(
+        est, days[:2], feed["validation"], chain_dir=str(tmp_path / "b"),
+        evaluator_specs=SPECS, gate_margin=0.05, dtype=jnp.float64,
+    )
+    # day 2 only touches e0..e4; e5.. carry forward bitwise from day 1
+    day0_re = first.model.models["per-user"]
+    re2 = chained.model.models["per-user"]
+    assert chained.ledger[1].accepted
+    untouched = [f"e{k}" for k in range(N_ENT // 2, N_ENT)]
+    carried = [e for e in untouched if day0_re.entity_row(e) >= 0]
+    assert carried, "no untouched entities materialized on day 1"
+    S0 = day0_re.coef_indices.shape[1]
+    for e in carried:
+        r0, r2 = day0_re.entity_row(e), re2.entity_row(e)
+        assert r2 >= 0
+        np.testing.assert_array_equal(
+            np.asarray(re2.coef_indices[r2])[:S0],
+            np.asarray(day0_re.coef_indices[r0]),
+        )
+        np.testing.assert_array_equal(
+            np.asarray(re2.coef_values[r2])[:S0],
+            np.asarray(day0_re.coef_values[r0]),
+        )
+        np.testing.assert_array_equal(np.asarray(re2.coef_indices[r2])[S0:], -1)
+
+
+def test_gate_blocks_degraded_day_and_counts_refusal(feed, run, tmp_path):
+    day0 = feed["days"][0]
+    poisoned_raw = feed["days"][1][1]
+    # flip the day's labels: the candidate learns the inverted signal and
+    # must degrade AUC on the held-out set
+    import dataclasses as _dc
+
+    poisoned_raw = _dc.replace(poisoned_raw, labels=1.0 - poisoned_raw.labels)
+    srv = str(tmp_path / "serving")
+    res = incremental.run_chain(
+        _estimator(),
+        [day0, ("20260102", poisoned_raw)],
+        feed["validation"],
+        chain_dir=str(tmp_path / "chain"),
+        serving_root=srv,
+        evaluator_specs=SPECS,
+        dtype=jnp.float64,
+    )
+    assert res.ledger[0].accepted and res.ledger[0].published
+    assert not res.ledger[1].accepted
+    assert res.ledger[1].reason.startswith("degraded:")
+    assert res.ledger[1].snapshot is None
+    # the poisoned day never reaches the live store: day 1 keeps serving
+    assert refresh.current_snapshot(srv) == res.ledger[0].snapshot
+    # the live model is still day 1's (the chain did not advance)
+    np.testing.assert_array_equal(
+        np.asarray(res.model.models["per-user"].coef_values),
+        np.asarray(
+            incremental.run_chain(
+                _estimator(), [day0], feed["validation"],
+                chain_dir=str(tmp_path / "solo"), evaluator_specs=SPECS,
+                dtype=jnp.float64,
+            ).model.models["per-user"].coef_values
+        ),
+    )
+    snap = run.registry.snapshot()
+    rejected = [
+        m for m in snap if m["name"] == "photon_retrain_rejected_total"
+    ]
+    assert rejected and rejected[0].get("labels", {}).get("reason") == res.ledger[1].reason
+    days_m = {
+        m.get("labels", {}).get("outcome"): m["value"]
+        for m in snap
+        if m["name"] == "photon_retrain_days_total"
+    }
+    assert days_m["rejected"] == 1
+
+
+def test_gate_refuses_non_finite_candidate(feed, run):
+    fe = FixedEffectModel(
+        model=LogisticRegressionModel(
+            Coefficients(jnp.asarray([np.nan] * D_FIXED))
+        ),
+        feature_shard="global",
+    )
+    bad = GameModel(models={"global": fe}, task="logistic_regression")
+    decision = incremental.no_degrade_gate(
+        bad, None, feed["validation"], ["AUC"], dtype=jnp.float64
+    )
+    assert not decision.accepted and decision.reason == "non-finite"
+
+
+def test_gate_first_publish_without_live_model(feed, run, tmp_path):
+    res = incremental.run_chain(
+        _estimator(), feed["days"][:1], feed["validation"],
+        chain_dir=str(tmp_path / "chain"), evaluator_specs=SPECS,
+        dtype=jnp.float64,
+    )
+    assert res.ledger[0].accepted and res.ledger[0].reason == "first-publish"
+
+
+# -- failure drills ----------------------------------------------------------
+
+
+def _chain_days_json(chain_dir):
+    with open(os.path.join(chain_dir, incremental.CHAIN_STATE_NAME)) as f:
+        return json.load(f)["days"]
+
+
+def test_kill_between_days_resumes_identical_ledger(feed, run, tmp_path):
+    kw = dict(
+        evaluator_specs=SPECS, dtype=jnp.float64, index_maps=_index_maps()
+    )
+    baseline_dir = str(tmp_path / "baseline")
+    incremental.run_chain(
+        _estimator(), feed["days"], feed["validation"],
+        chain_dir=baseline_dir, **kw,
+    )
+
+    drilled_dir = str(tmp_path / "drilled")
+    faults.configure("retrain.day:kill:2")
+    try:
+        with pytest.raises(SimulatedKill):
+            incremental.run_chain(
+                _estimator(), feed["days"], feed["validation"],
+                chain_dir=drilled_dir, **kw,
+            )
+    finally:
+        faults.clear()
+    # the crash-between-days drill: day 1's decision is already durable
+    assert len(_chain_days_json(drilled_dir)) == 1
+    res = incremental.run_chain(
+        _estimator(), feed["days"], feed["validation"],
+        chain_dir=drilled_dir, **kw,
+    )
+    assert len(res.ledger) == 3
+    # the resumed ledger is bit-exact against the uninterrupted run's
+    assert _chain_days_json(drilled_dir) == _chain_days_json(baseline_dir)
+
+
+def test_midday_kill_resumes_via_boundary_checkpoints(feed, run, tmp_path):
+    kw = dict(
+        evaluator_specs=SPECS, dtype=jnp.float64, index_maps=_index_maps(),
+        checkpoint_every=1,
+    )
+    baseline_dir = str(tmp_path / "baseline")
+    incremental.run_chain(
+        _estimator(), feed["days"][:2], feed["validation"],
+        chain_dir=baseline_dir, **kw,
+    )
+
+    drilled_dir = str(tmp_path / "drilled")
+    # 2 coordinates x 2 sweeps = 4 boundaries/day; boundary 6 is mid-day-2
+    faults.configure("cd.boundary:kill:6")
+    try:
+        with pytest.raises(SimulatedKill):
+            incremental.run_chain(
+                _estimator(), feed["days"][:2], feed["validation"],
+                chain_dir=drilled_dir, **kw,
+            )
+    finally:
+        faults.clear()
+    state = json.load(
+        open(os.path.join(drilled_dir, incremental.CHAIN_STATE_NAME))
+    )
+    assert state["in_progress"] == "20260102"
+    # the day's boundary checkpoints identify their chain position on their
+    # own: manifests carry the day label and the decided ledger so far
+    day_dir = os.path.join(drilled_dir, "checkpoints", "day-0001")
+    manifests = [
+        json.load(open(os.path.join(day_dir, d, "MANIFEST.json")))
+        for d in sorted(os.listdir(day_dir))
+        if os.path.isdir(os.path.join(day_dir, d))
+    ]
+    assert manifests
+    assert all(m["chain_day"] == "20260102" for m in manifests)
+    assert all(len(m["chain_ledger"]) == 1 for m in manifests)
+
+    res = incremental.run_chain(
+        _estimator(), feed["days"][:2], feed["validation"],
+        chain_dir=drilled_dir, **kw,
+    )
+    assert len(res.ledger) == 2 and res.ledger[1].day == "20260102"
+    assert _chain_days_json(drilled_dir) == _chain_days_json(baseline_dir)
+
+
+def test_torn_publish_keeps_old_snapshot_and_repairs_next_cycle(
+    feed, run, tmp_path
+):
+    srv = str(tmp_path / "serving")
+    chain = str(tmp_path / "chain")
+    kw = dict(
+        evaluator_specs=SPECS, dtype=jnp.float64, index_maps=_index_maps(),
+        serving_root=srv,
+    )
+    faults.configure("retrain.publish:io:1")
+    try:
+        res = incremental.run_chain(
+            _estimator(), feed["days"][:1], feed["validation"],
+            chain_dir=chain, **kw,
+        )
+    finally:
+        faults.clear()
+    # the decision is durable, the publish is not: nothing serves yet
+    assert res.ledger[0].accepted and not res.ledger[0].published
+    assert refresh.current_snapshot(srv) is None
+    snap = run.registry.snapshot()
+    assert any(
+        m["name"] == "photon_swallowed_errors_total"
+        and m.get("labels", {}).get("site") == "retrain.publish"
+        for m in snap
+    )
+
+    # next cycle repairs the store WITHOUT retraining the decided day
+    def boom():
+        raise AssertionError("decided day must not reload on repair")
+
+    res2 = incremental.run_chain(
+        _estimator(), [("20260101", boom)], feed["validation"],
+        chain_dir=chain, **kw,
+    )
+    assert refresh.current_snapshot(srv) == res.ledger[0].snapshot
+    assert res2.ledger[0].published
+    snap = run.registry.snapshot()
+    published = [
+        m for m in snap if m["name"] == "photon_retrain_published_total"
+    ]
+    assert published and published[0]["value"] == 1
+
+
+# -- prior-index compatibility (cli train --incremental-training) ------------
+
+
+def _save_prior(tmp_path, index_maps):
+    model = GameModel(
+        models={
+            "global": FixedEffectModel(
+                model=LogisticRegressionModel(
+                    Coefficients(jnp.arange(1.0, D_FIXED + 1))
+                ),
+                feature_shard="global",
+            ),
+            "per-user": _re(["uA"], [[0, 1, 2]], [[1.0, 2.0, 3.0]]),
+        },
+        task="logistic_regression",
+    )
+    d = str(tmp_path / "prior")
+    save_game_model(d, model, index_maps)
+    return d
+
+
+def test_prior_compatibility_exact_remap_refused(tmp_path):
+    imaps = _index_maps()
+    model_dir = _save_prior(tmp_path, imaps)
+
+    assert check_prior_compatibility(model_dir, imaps) == {
+        "global": "exact", "userShard": "exact",
+    }
+    # permuted index: same key set, different layout -> lossless remap
+    # (from_name_terms sorts keys, so permute via an explicit key->index dict)
+    permuted = dict(imaps)
+    permuted["global"] = IndexMap(
+        {feature_key(f"g{j}", ""): D_FIXED - 1 - j for j in range(D_FIXED)}
+    )
+    assert check_prior_compatibility(model_dir, permuted)["global"] == "remap"
+    # an index missing a prior feature would silently zero its prior mean:
+    # refused, not remapped
+    shrunk = dict(imaps)
+    shrunk["global"] = IndexMap.from_name_terms(
+        [(f"g{j}", "") for j in range(D_FIXED - 1)]
+    )
+    with pytest.raises(
+        ValueError,
+        match="prior model features absent from the current feature index",
+    ):
+        check_prior_compatibility(model_dir, shrunk)
+    # a shard with no current index at all is the same refusal
+    with pytest.raises(
+        ValueError,
+        match="prior model features absent from the current feature index",
+    ):
+        check_prior_compatibility(model_dir, {"global": imaps["global"]})
+
+
+def test_fingerprint_stamped_into_model_meta(tmp_path):
+    imaps = _index_maps()
+    model_dir = _save_prior(tmp_path, imaps)
+    meta = json.load(open(os.path.join(model_dir, "model-metadata.json")))
+    fp = meta["featureIndexFingerprint"]
+    assert set(fp["shards"]) == {"global", "userShard"}
+    assert fp["shards"]["global"]["size"] == D_FIXED
+    # the remap/exact fast path keys off these digests
+    assert fp["shards"]["global"]["keys"] != fp["shards"]["userShard"]["keys"]
+
+
+def test_prior_round_trips_through_chain_store(feed, run, tmp_path):
+    """The chain's models/day-* store must reload bit-exact: resume quality
+    depends on it (day k+1 warm-starts from the reloaded model)."""
+    imaps = _index_maps()
+    res = incremental.run_chain(
+        _estimator(), feed["days"][:1], feed["validation"],
+        chain_dir=str(tmp_path / "chain"), evaluator_specs=SPECS,
+        dtype=jnp.float64, index_maps=imaps,
+    )
+    reloaded = load_game_model(
+        os.path.join(str(tmp_path / "chain"), "models", "day-20260101"),
+        imaps,
+        task="logistic_regression",
+    )
+    np.testing.assert_array_equal(
+        np.asarray(res.model.models["global"].model.coefficients.means),
+        np.asarray(reloaded.models["global"].model.coefficients.means),
+    )
+    re0, re1 = res.model.models["per-user"], reloaded.models["per-user"]
+    assert sorted(map(str, re0.entity_ids)) == sorted(map(str, re1.entity_ids))
+    for e in map(str, re0.entity_ids):
+        a = np.asarray(re0.coef_values[re0.entity_row(e)])
+        b = np.asarray(re1.coef_values[re1.entity_row(e)])
+        np.testing.assert_array_equal(np.sort(a), np.sort(b))

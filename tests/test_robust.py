@@ -852,3 +852,25 @@ def test_cli_checkpoint_flags_parse():
     assert args.resume is True
     defaults = build_parser().parse_args(["--input-data", "in", "--output-dir", "o"])
     assert defaults.checkpoint_every == 0 and not defaults.resume
+
+
+def test_retrain_fault_sites_parse_and_fire(run):
+    """The continuous-training drill sites speak the standard grammar:
+    retrain.day (crash between chain days) and retrain.publish (torn
+    publish into the serving store)."""
+    specs = parse_faults("retrain.day:kill:2,retrain.publish:io:1")
+    assert specs[0] == FaultSpec(site="retrain.day", kind="kill", at=2)
+    assert specs[1] == FaultSpec(site="retrain.publish", kind="io", at=1)
+
+    faults.configure("retrain.day:kill:2,retrain.publish:io:1")
+    faults.check("retrain.day")  # day 1 survives
+    with pytest.raises(InjectedIOError):
+        faults.check("retrain.publish")
+    with pytest.raises(SimulatedKill):
+        faults.check("retrain.day")
+    assert counter_value(
+        run, "photon_faults_injected_total", site="retrain.day", kind="kill"
+    ) == 1
+    assert counter_value(
+        run, "photon_faults_injected_total", site="retrain.publish", kind="io"
+    ) == 1

@@ -550,3 +550,69 @@ def test_histogram_quantile_interpolation():
     assert obs.histogram_quantile(buckets, 100, 0.99) == 5.0
     assert obs.histogram_quantile(buckets, 0, 0.5) == 0.0
     assert obs.histogram_quantile([], 10, 0.5) == 0.0
+
+
+def test_retrain_publish_three_daily_flips_drill(tmp_path, run_telemetry):
+    """Continuous-training drill: three consecutive daily publishes through
+    the chain's real publish path (incremental._ensure_published) flip a
+    live server mid-stream. Zero lost requests, every response from exactly
+    one published model, and the flip sequence is monotone day over day."""
+    from photon_ml_tpu.game import incremental
+
+    root = str(tmp_path / "root")
+    models = [make_model(fe_shift=100.0 * k, seed=5) for k in range(4)]
+    serving.publish_snapshot(root, "retrain-20260101", game_model=models[0])
+    server = serving.ScoringServer(
+        serving_root=root, max_batch=8, max_latency_ms=1.0,
+        poll_seconds=3600.0, dtype=jnp.float64,
+    )
+    rng = np.random.default_rng(41)
+    reqs = [make_request(rng, ["uA", "uB", "uC"][i % 3]) for i in range(80)]
+    exp = np.stack([[oracle_score(m, r) for r in reqs] for m in models])
+    # any two of the four dailies are distinguishable on every request
+    for a in range(4):
+        for b in range(a + 1, 4):
+            assert np.min(np.abs(exp[a] - exp[b])) > 1.0
+
+    def _publish(day_index):
+        day = f"2026010{day_index + 1}"
+        rec = incremental.DayRecord(
+            day=day, index=day_index, accepted=True, reason="accepted",
+            rows=0, touched_entities={}, snapshot=f"retrain-{day}",
+        )
+        assert incremental._ensure_published(root, rec, models[day_index])
+
+    try:
+        futs = []
+        for i, r in enumerate(reqs):
+            futs.append(server.submit(r))
+            if i in (20, 40, 60):  # three consecutive daily flips mid-stream
+                _publish(i // 20)
+                server.poke_refresh()
+            time.sleep(0.001)
+        got = np.array([f.result(timeout=30.0) for f in futs])  # zero lost
+        source = np.full(len(reqs), -1)
+        for k in range(4):
+            hit = np.isclose(got, exp[k], rtol=0, atol=1e-9)
+            assert np.all(source[hit] == -1)  # exactly one model per response
+            source[hit] = k
+        assert np.all(source >= 0)
+        # day-over-day monotone: the served model index never goes backwards
+        assert np.all(np.diff(source) >= 0)
+        assert source[-1] == 3 and server.snapshot_name == "retrain-20260104"
+
+        snap = run_telemetry.registry.snapshot()
+        refreshes = [
+            m for m in snap if m["name"] == "photon_serving_refresh_total"
+        ]
+        assert refreshes and refreshes[0]["value"] == 3
+        published = [
+            m for m in snap if m["name"] == "photon_retrain_published_total"
+        ]
+        assert published and published[0]["value"] == 3
+        errs = [
+            m for m in snap if m["name"] == "photon_serving_request_errors_total"
+        ]
+        assert not errs
+    finally:
+        server.close()

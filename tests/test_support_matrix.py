@@ -255,8 +255,105 @@ def _trigger_lanes_regularize_by_prior(raw):
     _lane_check([_fe(regularize_by_prior=True)])
 
 
+def _trigger_retrain_distributed(raw):
+    from photon_ml_tpu.cli.params import check_retrain_composition
+
+    check_retrain_composition(True, 1)
+
+
+def _trigger_retrain_trial_lanes(raw):
+    from photon_ml_tpu.cli.params import check_retrain_composition
+
+    check_retrain_composition(False, 4)
+
+
+def _trigger_retrain_streamed(raw):
+    from photon_ml_tpu.cli.params import check_retrain_composition
+
+    check_retrain_composition(False, 1, ["global"])
+
+
+def _trigger_prior_index_mismatch(raw, tmp_path):
+    from photon_ml_tpu.io.index_map import IndexMap
+    from photon_ml_tpu.io.model_io import (
+        check_prior_compatibility,
+        save_game_model,
+    )
+    from photon_ml_tpu.models.game import FixedEffectModel, GameModel
+    from photon_ml_tpu.models.glm import Coefficients, LogisticRegressionModel
+
+    imaps = {
+        "global": IndexMap.from_name_terms(
+            [("f0", ""), ("f1", "")], add_intercept=False
+        )
+    }
+    model = GameModel(
+        models={
+            "global": FixedEffectModel(
+                model=LogisticRegressionModel(
+                    Coefficients(jnp.asarray([1.0, 2.0]))
+                ),
+                feature_shard="global",
+            )
+        },
+        task="logistic_regression",
+    )
+    model_dir = str(tmp_path / "prior")
+    save_game_model(model_dir, model, imaps)
+    shrunk = {
+        "global": IndexMap.from_name_terms([("f0", "")], add_intercept=False)
+    }
+    check_prior_compatibility(model_dir, shrunk)
+
+
+def _trigger_chain_state_version(raw, tmp_path):
+    import json
+
+    from photon_ml_tpu.game import incremental
+
+    chain_dir = tmp_path / "chain"
+    chain_dir.mkdir()
+    (chain_dir / incremental.CHAIN_STATE_NAME).write_text(
+        json.dumps({"version": 99, "days": []})
+    )
+    incremental._load_chain_state(str(chain_dir))
+
+
 CASES = [
     # (id, documented message fragment, exception type, trigger)
+    (
+        "chain-state-version",
+        "unsupported chain-state version",
+        ValueError,
+        _trigger_chain_state_version,
+    ),
+    (
+        "retrain-distributed",
+        "incremental retrain is single-process: not composable with "
+        "--distributed",
+        ValueError,
+        _trigger_retrain_distributed,
+    ),
+    (
+        "retrain-trial-lanes",
+        "incremental retrain warm-starts with regularize-by-prior: not "
+        "composable with --trial-lanes",
+        ValueError,
+        _trigger_retrain_trial_lanes,
+    ),
+    (
+        "retrain-streamed",
+        "incremental retrain requires HBM-resident coordinates: not "
+        "composable with hbm.budget.mb streaming",
+        ValueError,
+        _trigger_retrain_streamed,
+    ),
+    (
+        "prior-index-mismatch",
+        "prior model features absent from the current feature index",
+        ValueError,
+        _trigger_prior_index_mismatch,
+    ),
     (
         "lanes-mesh",
         "trial-lanes sweeps are single-chip: not composable with a device "
