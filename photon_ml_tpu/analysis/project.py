@@ -1144,7 +1144,10 @@ def extract_raise_sites(sources: Mapping[str, str]) -> List[RaiseSite]:
             if name is None:
                 continue
             exc_name = name.split(".")[-1]
-            if exc_name not in ("ValueError", "NotImplementedError"):
+            # PlanError is the execution planner's typed refusal (a
+            # ValueError subclass, plan/planner.py) — its sites ARE the
+            # ledger's canonical raise sites
+            if exc_name not in ("ValueError", "NotImplementedError", "PlanError"):
                 continue
             segments = _msg_segments(exc.args[0])
             if segments is None:

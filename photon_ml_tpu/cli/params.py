@@ -58,7 +58,9 @@ def parse_coordinate(spec: str) -> CoordinateConfig:
     effects: the batch is partitioned into row slices that stream through
     the chip double-buffered while the solver runs on the host
     (game/fe_streaming.py; layouts auto|dense|ell, variance NONE only, no
-    down-sampling, not composable with a device mesh)."""
+    down-sampling). Composes with a device mesh / multi-process: each host
+    streams its own shard under the per-host budget — the execution planner
+    (plan/planner.py) resolves the full routing and owns every refusal."""
     kv = parse_kv(spec)
     name = kv.pop("name")
     shard = kv.pop("shard")
@@ -239,52 +241,15 @@ def parse_pipeline_depth(value) -> int:
     return depth
 
 
-def check_pipeline_composition(depth: int, distributed: bool) -> None:
-    """Refuse the illegal pipelining compositions up front (support-matrix
-    ledger). Multi-process training issues collectives that every host must
-    enter in the same order; a background eval/staging lane would let that
-    order diverge per host and deadlock the mesh — refused until the lanes
-    are made collective-aware."""
-    if depth > 1 and distributed:
-        raise ValueError(
-            f"pipeline.depth={depth} is not supported with --distributed "
-            "(multi-process collectives must be entered in one global order; "
-            "background pipeline lanes would reorder them per host); use "
-            "pipeline.depth=1"
-        )
-
-
 def check_retrain_composition(
     distributed: bool, trial_lanes: int, streamed_coordinates=()
 ) -> None:
-    """Refuse the illegal incremental-retrain compositions up front, in one
-    place (support-matrix ledger). The day chain is a local control loop: it
-    loads/merges host-resident models, appends a durable ledger, and flips a
-    local serving store — none of which is collective-aware; trial lanes are
-    already refused with regularize-by-prior (the warm-start mechanism the
-    chain is built on); streamed coordinates never materialize the
-    host-resident models the per-day entity merge carries forward."""
-    if distributed:
-        raise ValueError(
-            "incremental retrain is single-process: not composable with "
-            "--distributed (the day chain's ledger, model merge and serving "
-            "publish are host-local; shard the feed by day across hosts "
-            "instead)"
-        )
-    if trial_lanes and trial_lanes > 1:
-        raise ValueError(
-            "incremental retrain warm-starts with regularize-by-prior: not "
-            "composable with --trial-lanes (the lane solver has no per-lane "
-            "prior operand)"
-        )
-    streamed = [str(c) for c in streamed_coordinates if c]
-    if streamed:
-        raise ValueError(
-            "incremental retrain requires HBM-resident coordinates: not "
-            "composable with hbm.budget.mb streaming (the per-day entity "
-            f"merge carries host-resident models forward) — remove "
-            f"hbm.budget.mb from {sorted(streamed)}"
-        )
+    """Refuse the illegal incremental-retrain compositions up front —
+    delegates to the execution planner (plan/planner.py), which owns every
+    composition-legality message in the support-matrix ledger."""
+    from ..plan import check_retrain_composition as _check
+
+    _check(distributed, trial_lanes, streamed_coordinates)
 
 
 def build_shard_configs(args) -> Dict[str, FeatureShardConfig]:

@@ -49,7 +49,6 @@ from ..utils.stats import compute_feature_statistics, save_feature_statistics
 from .params import (
     add_common_io_args,
     build_shard_configs,
-    check_pipeline_composition,
     parse_coordinate,
     parse_input_columns,
     parse_mesh_shape,
@@ -126,8 +125,18 @@ def build_parser() -> argparse.ArgumentParser:
         help="sweep pipelining depth (pipeline.depth): 1 = serial loop "
         "(default); >= 2 overlaps host staging, device solves and "
         "validation eval across coordinates with bit-identical accepted "
-        "models, ledger and checkpoints (game/pipeline.py). Not supported "
-        "with --distributed",
+        "models, ledger and checkpoints (game/pipeline.py). Composes with "
+        "--distributed; the execution planner (plan/planner.py) resolves "
+        "the full routing",
+    )
+    p.add_argument(
+        "--explain-plan",
+        action="store_true",
+        help="dry run: resolve the execution plan (per-coordinate routing: "
+        "resident vs streamed, sharded vs replicated, pipelined vs serial, "
+        "slice/shard geometry) from the flags alone, pretty-print it and "
+        "exit 0 WITHOUT reading data or touching a device; a refused "
+        "configuration prints its PlanError and exits 1",
     )
     p.add_argument("--output-dir", required=True)
     p.add_argument(
@@ -277,6 +286,10 @@ def build_parser() -> argparse.ArgumentParser:
 def run(argv: Optional[List[str]] = None) -> Dict:
     args = build_parser().parse_args(argv)
     setup_logging(args.log_level, args.log_file)
+    if args.explain_plan:
+        # dry run: resolve and print the execution plan from the flags
+        # alone — no data read, no device touched, no jax import
+        return _explain_plan(args)
     # PHOTON_FAULTS / PHOTON_FAULTS_SEED: deterministic fault injection at IO
     # and checkpoint boundaries (robust.faults); absent env clears any
     # injector a previous in-process run installed
@@ -285,9 +298,6 @@ def run(argv: Optional[List[str]] = None) -> Dict:
     from ..utils.compile_cache import enable_persistent_compilation_cache
 
     enable_persistent_compilation_cache()
-
-    # refuse illegal pipelining compositions before any expensive setup
-    check_pipeline_composition(args.pipeline_depth, bool(args.distributed))
 
     if args.distributed:
         if args.distributed == "auto":
@@ -376,6 +386,69 @@ def run(argv: Optional[List[str]] = None) -> Dict:
     if args.report_out and multihost.is_coordinator():
         _emit_report(args)
     return summary
+
+
+def _explain_plan(args) -> Dict:
+    """``--explain-plan``: resolve the ExecutionPlan from the parsed flags and
+    pretty-print it, reading no data and touching no device (the planner is
+    jax-free, so this works on a host with no accelerator runtime). A refused
+    configuration prints its PlanError and exits 1; a resolved plan prints
+    and the process exits 0 (in-process callers get the plan document)."""
+    from ..plan import PlanError, resolve as resolve_plan
+    from .params import parse_kv
+
+    coord_specs = args.coordinate or [
+        "name=global,shard=global,optimizer=LBFGS,reg.type=L2,reg.weights=1"
+    ]
+    try:
+        coords = [parse_coordinate(s) for s in coord_specs]
+        if args.incremental_training:
+            for cc in coords:
+                cc.regularize_by_prior = True
+        mesh = None
+        if args.mesh_shape:
+            kv = parse_kv(args.mesh_shape)
+            mesh = {"data": int(kv.pop("data", 1)),
+                    "model": int(kv.pop("model", 1))}
+            if kv:
+                raise SystemExit(f"unknown mesh keys: {sorted(kv)}")
+        n_processes = 1
+        if args.distributed and args.distributed != "auto":
+            for part in args.distributed.split(","):
+                k, _, v = part.partition("=")
+                if k.strip() == "n":
+                    n_processes = int(v)
+        dims = None
+        if args.feature_index_dir:
+            # index maps are metadata, not training data: load them so the
+            # plan carries concrete slice geometry; advisory, never fatal
+            try:
+                from ..io.index_map import load_partitioned
+
+                dims = {
+                    s: load_partitioned(args.feature_index_dir, s).size
+                    for s in build_shard_configs(args)
+                }
+            except Exception:  # photon: ignore[R4] - dims only enrich the
+                dims = None  # printed geometry; a dry run must never fail here
+        plan = resolve_plan(
+            coords,
+            mesh=mesh,
+            n_processes=n_processes,
+            pipeline_depth=args.pipeline_depth,
+            trial_lanes=int(getattr(args, "trial_lanes", 1) or 1),
+            distributed=bool(args.distributed),
+            partial_retrain_locked=tuple(
+                c for c in args.partial_retrain_locked.split(",") if c
+            ),
+            normalization=args.normalization,
+            dims=dims,
+        )
+    except PlanError as e:
+        print(f"plan refused: {e}", file=sys.stderr)
+        raise SystemExit(1)
+    print(plan.pretty())
+    return {"plan": plan.to_dict()}
 
 
 def _run_training(args, run_t, metric_sinks, t_run0, recorder=None) -> Dict:
@@ -580,10 +653,25 @@ def _run_training(args, run_t, metric_sinks, t_run0, recorder=None) -> Dict:
         rejection_tolerance=args.coordinate_rejection_tolerance,
         pipeline_depth=args.pipeline_depth,
     )
+    if int(getattr(args, "trial_lanes", 1) or 1) > 1:
+        from ..game.lanes import check_lane_composition
+
+        # pre-empt lane-composition refusals at plan time — BEFORE any
+        # dataset build or grid-config training, the same check the lane
+        # path re-runs at fit_lanes time (and --explain-plan dry-runs)
+        check_lane_composition(
+            estimator,
+            int(args.trial_lanes),
+            distributed=multihost.process_count() > 1,
+        )
     for sink in metric_sinks:
         # estimator lifecycle events (TrainingStart/OptimizationLog/Finish)
         # land in the same JSONL stream as spans and metric flushes
         estimator.register_listener(sink)
+    if run_t is not None:
+        # attach the resolved execution plan so run_summary.json and the
+        # live /statusz endpoint both surface the per-coordinate routing
+        run_t.execution_plan = estimator.execution_plan.to_dict()
     ckpt = None
     # datasets are reg-weight-independent: build once, lazily (an idempotent
     # rerun of a completed checkpoint must not pay the device build), and
@@ -711,6 +799,9 @@ def _write_run_summary(args, run_t, recorder, t_run0, summary=None,
         run_t.registry, total_wall_seconds=time.perf_counter() - t_run0
     )
     doc["task"] = getattr(args, "task", None) if summary is None else summary["task"]
+    plan = getattr(run_t, "execution_plan", None)
+    if plan is not None:
+        doc["plan"] = plan
     if summary is not None:
         doc["best"] = summary["best"]
     if aborted:

@@ -35,6 +35,7 @@ from ..game.problem import GLMOptimizationConfig
 from ..io.data import RawDataset
 from ..models.game import GameModel
 from ..ops.normalization import NormalizationContext
+from .. import plan as execution_plan
 from ..utils.events import (
     EventEmitter,
     OptimizationLogEvent,
@@ -78,7 +79,8 @@ class CoordinateConfig:
     # spill scale path). Random effects stream entity slices
     # (game/streaming.py); fixed effects stream row slices
     # (game/fe_streaming.py — layouts auto|dense|ell, variance NONE, no
-    # down-sampling). Single-process; not composable with a mesh.
+    # down-sampling). Composes with a mesh / multi-process: each host
+    # streams its own shard under the per-host budget (plan/planner.py).
     hbm_budget_mb: Optional[int] = None
 
     @property
@@ -148,59 +150,29 @@ class GameEstimator(EventEmitter):
         unknown = self.partial_retrain_locked - set(names)
         if unknown:
             raise ValueError(f"locked coordinates not in configs: {sorted(unknown)}")
-        for cc in self.coordinate_configs:
-            if cc.feature_dtype is not None and cc.layout == "tiled":
-                # dense/ell/coo fixed effects and RE entity blocks all accept
-                # narrow feature storage (solver state stays wide); the tiled
-                # shard_map path keeps its value arrays in the solve dtype
-                raise ValueError(
-                    f"coordinate {cc.name}: feature_dtype is not supported "
-                    "with layout='tiled'"
-                )
-            if cc.hbm_budget_mb is not None and not cc.is_random_effect:
-                # the streamed FE path slices on the row axis: only row-major
-                # layouts stream; the Hessian-free out-of-core objective never
-                # materializes variances; down-sampling is a resident-batch op
-                if cc.layout not in ("auto", "dense", "ell"):
-                    raise ValueError(
-                        f"coordinate {cc.name}: hbm_budget_mb on a fixed "
-                        "effect requires a row-sliceable layout "
-                        f"(auto|dense|ell), got layout={cc.layout!r}"
-                    )
-                if cc.config.variance_type.upper() != "NONE":
-                    raise ValueError(
-                        f"coordinate {cc.name}: variance="
-                        f"{cc.config.variance_type.upper()} is not supported "
-                        "with hbm_budget_mb on a fixed effect (out-of-core "
-                        "row slices never materialize the Hessian); use "
-                        "variance=NONE"
-                    )
-                if cc.config.down_sampling_rate < 1.0:
-                    raise ValueError(
-                        f"coordinate {cc.name}: down_sampling_rate < 1 is not "
-                        "supported with hbm_budget_mb on a fixed effect"
-                    )
-            if cc.hbm_budget_mb is not None and mesh is not None:
-                raise ValueError(
-                    f"coordinate {cc.name}: streamed (hbm_budget_mb) and "
-                    "mesh-sharded coordinates are not composable yet — "
-                    "streaming scales one chip's HBM, the mesh shards "
-                    "across chips"
-                )
-            if cc.layout == "tiled":
-                if mesh is None:
-                    raise ValueError(
-                        f"coordinate {cc.name}: layout='tiled' requires the "
-                        "estimator to be built with a device mesh"
-                    )
-                # normalization works on tiled: GLMProblem pads the stats
-                # vectors to the mesh-padded dim with identity entries (the
-                # reference algebra is layout-agnostic,
-                # ValueAndGradientAggregator.scala:36-80)
-                # variance=FULL is supported on tiled via the chunked sharded
-                # X^T diag(c) X path (parallel/sparse.py xtcx) up to
-                # ops.glm.MAX_FULL_VARIANCE_DIM; the dim ceiling is checked at
-                # train time when d is known
+        # ALL composition legality (layout x dtype x mesh x streaming x
+        # pipelining) is the execution planner's: one resolve up front
+        # replaces the per-knob checks that used to live here, and the
+        # resolved plan stays introspectable for --explain-plan /
+        # run_summary.json (plan/planner.py). Refusals raise PlanError (a
+        # ValueError) with the ledger-pinned messages.
+        # Notes the planner's routing table encodes:
+        # - normalization works on tiled: GLMProblem pads the stats vectors
+        #   to the mesh-padded dim with identity entries (the reference
+        #   algebra is layout-agnostic, ValueAndGradientAggregator.scala)
+        # - variance=FULL is supported on tiled via the chunked sharded
+        #   X^T diag(c) X path (parallel/sparse.py xtcx) up to
+        #   ops.glm.MAX_FULL_VARIANCE_DIM; the dim ceiling is checked at
+        #   train time when d is known
+        import jax
+
+        self.execution_plan = execution_plan.resolve(
+            self.coordinate_configs,
+            mesh=mesh,
+            n_processes=jax.process_count(),
+            pipeline_depth=self.pipeline_depth,
+            partial_retrain_locked=tuple(self.partial_retrain_locked),
+        )
 
     # -- dataset preparation -------------------------------------------------
 
@@ -208,11 +180,9 @@ class GameEstimator(EventEmitter):
         import jax
 
         multiprocess = jax.process_count() > 1
-        if multiprocess and self.mesh is None:
-            raise ValueError(
-                "multi-process training requires a device mesh spanning all "
-                "global devices (pass mesh= to GameEstimator)"
-            )
+        # re-checked here (not just at __init__) because process topology can
+        # be initialized between estimator construction and the first fit
+        execution_plan.check_multiprocess_mesh(jax.process_count(), self.mesh)
         datasets = {}
         for cc in self.coordinate_configs:
             with timed(f"prepare dataset {cc.name}"):
@@ -235,6 +205,11 @@ class GameEstimator(EventEmitter):
                             pad_entities_to_multiple=self.entity_pad_multiple,
                             features_to_samples_ratio=cc.features_to_samples_ratio,
                             feature_dtype=cc.feature_dtype,
+                            hbm_budget_bytes=(
+                                cc.hbm_budget_mb * (1 << 20)
+                                if cc.hbm_budget_mb is not None
+                                else None
+                            ),
                         )
                         datasets[cc.name] = ds
                         continue
@@ -255,7 +230,10 @@ class GameEstimator(EventEmitter):
                             else None
                         ),
                     )
-                    if self.mesh is not None:
+                    if self.mesh is not None and not ds.streamed:
+                        # streamed blocks are host-resident by design: they
+                        # stream through the chip in slices, so there is
+                        # nothing to place on the mesh
                         from ..parallel.mesh import shard_entity_blocks
 
                         ds = dataclasses.replace(

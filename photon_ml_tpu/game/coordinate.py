@@ -240,6 +240,25 @@ class FixedEffectCoordinate(Coordinate):
             hb = dataclasses.replace(
                 hb, offsets=faults.corrupt("solver.value_and_grad", hb.offsets)
             )
+        if (
+            residual_scores is not None
+            and ds.mesh is not None
+            and jax.process_count() > 1
+        ):
+            # the residual is the global row-sharded [N] vector; this host
+            # streams only ITS row slice, so hand the objective the local
+            # block (trimmed of the per-host mesh padding rows). A fully
+            # replicated residual (e.g. the zeros vector of the first sweep)
+            # comes back global from host_local_rows — slice this process's
+            # padded block out of it first.
+            from ..parallel import multihost
+
+            local = multihost.host_local_rows(residual_scores)
+            n_loc_pad = self.n_rows // jax.process_count()
+            if local.shape[0] > n_loc_pad:
+                start = jax.process_index() * n_loc_pad
+                local = local[start : start + n_loc_pad]
+            residual_scores = local[: hb.n_rows]
         problem = GLMProblem(
             task=self.task,
             config=self.config,
@@ -261,15 +280,37 @@ class FixedEffectCoordinate(Coordinate):
         if self.dataset.streamed:
             from .fe_streaming import score_streamed_fe
 
-            hb = self.dataset.host_batch
+            ds = self.dataset
+            hb = ds.host_batch
             dtype = hb.labels.dtype
             means = jnp.asarray(model.model.coefficients.means, dtype)
             d_pad = hb.dim - means.shape[0]
             if d_pad > 0:
                 means = jnp.concatenate([means, jnp.zeros((d_pad,), means.dtype)])
-            return score_streamed_fe(
-                hb, means, self.dataset.hbm_budget_bytes, dtype
-            )
+            scores = score_streamed_fe(hb, means, ds.hbm_budget_bytes, dtype)
+            if ds.mesh is not None and jax.process_count() > 1:
+                # local row scores -> global row-sharded vector: pad this
+                # host's slice to the per-host mesh chunk (zero-score pad
+                # rows, like pad_rows_for_mesh) and put_global
+                from jax.sharding import PartitionSpec
+                from ..parallel import multihost
+                from ..parallel.mesh import DATA_AXIS
+
+                local = np.asarray(
+                    logged_fetch("coordinate.fe_stream_score", scores)
+                )
+                chunk = max(
+                    ds.mesh.shape[DATA_AXIS] // jax.process_count(), 1
+                )
+                n_pad = -(-local.shape[0] // chunk) * chunk
+                if n_pad > local.shape[0]:
+                    local = np.concatenate(
+                        [local, np.zeros(n_pad - local.shape[0], local.dtype)]
+                    )
+                return multihost.put_global(
+                    local, ds.mesh, PartitionSpec(DATA_AXIS)
+                )
+            return scores
         feats = self.dataset.batch.features
         # compute in the dataset's dtype: a warm-start model loaded under an
         # x64 config is f64 and must not promote the f32 score/residual stream
@@ -315,7 +356,12 @@ class RandomEffectCoordinate(Coordinate):
 
     @property
     def n_rows(self) -> int:
-        return self.dataset.row_entity.shape[0]
+        ds = self.dataset
+        if ds.entity_shard_range is not None:
+            # streamed + sharded: the row arrays hold this host's equal-share
+            # slice of the padded global row space
+            return ds.row_entity.shape[0] * jax.process_count()
+        return ds.row_entity.shape[0]
 
     def train(
         self,
@@ -566,18 +612,23 @@ class RandomEffectCoordinate(Coordinate):
         from .streaming import solve_streamed
 
         ds = self.dataset
-        blocks = ds.blocks  # host numpy
+        blocks = ds.blocks  # host numpy (streamed+sharded: the local range)
         E, K, S = blocks.features.shape
         sdt = blocks.labels.dtype  # solve dtype (features may be narrower)
+        shard = ds.entity_shard_range  # set only when streamed + sharded
+        E_g = ds.num_entities  # global entity count (== E when unsharded)
 
+        # warm start / priors are projected in the GLOBAL entity layout
+        # (_project_model_values keys off host_proj_cols), then sliced to
+        # this host's block-row range for the local solve
         if initial_model is not None:
             w0 = _project_model_values(
                 ds, initial_model, initial_model.coef_values, sdt, to_device=False
             )
         else:
-            w0 = np.zeros((E, S), sdt)
-        prior_mean = np.zeros((E, S), sdt)
-        prior_prec = np.ones((E, S), sdt)
+            w0 = np.zeros((E_g, S), sdt)
+        prior_mean = np.zeros((E_g, S), sdt)
+        prior_prec = np.ones((E_g, S), sdt)
         if self.prior_model is not None:
             prior_mean = _project_model_values(
                 ds, self.prior_model, self.prior_model.coef_values, sdt,
@@ -590,8 +641,26 @@ class RandomEffectCoordinate(Coordinate):
                 )
                 prior_prec = (1.0 / np.maximum(var, 1e-12)).astype(sdt)
 
+        if shard is not None:
+            from ..parallel import multihost
+
+            lo, hi = shard
+            w0 = w0[lo:hi]
+            prior_mean = prior_mean[lo:hi]
+            prior_prec = prior_prec[lo:hi]
+            if residual_scores is not None:
+                # local active_rows index the PADDED GLOBAL row space, so
+                # the solve needs the FULL residual addressable on this
+                # host: replicate, fetch, re-place as a plain local array
+                residual_scores = jnp.asarray(
+                    logged_fetch(
+                        "coordinate.stream_residual",
+                        multihost.fully_replicate(residual_scores, ds.mesh),
+                    )
+                )
+
         solver_kwargs = self._solver_kwargs()
-        segments = _size_buckets(ds) or [(0, E, K, S)]
+        segments = _size_buckets(ds, entity_range=shard) or [(0, E, K, S)]
         results = solve_streamed(
             blocks,
             segments,
@@ -603,7 +672,17 @@ class RandomEffectCoordinate(Coordinate):
             self._train_fn(),
             solver_kwargs,
         )
-        coef_indices = blocks.proj_cols
+        if shard is not None:
+            # every host solved ITS contiguous block-row range; process order
+            # IS entity order, so a host-side allgather + concat rebuilds the
+            # global result table on every host (the reference's
+            # collect-model-to-driver step, host-side because the tables are
+            # host numpy by streamed design)
+            parts = multihost.allgather_object(results)
+            results = _concat_results_np(parts)
+            coef_indices = np.asarray(ds.host_proj_cols)
+        else:
+            coef_indices = blocks.proj_cols
         valid = coef_indices >= 0
         model = RandomEffectModel(
             random_effect_type=ds.random_effect_type,
@@ -677,9 +756,17 @@ class RandomEffectCoordinate(Coordinate):
                     ds, model, model.coef_values, sdt, to_device=False
                 )
             cache = getattr(ds, "_stream_xsub_cache", None)
+            # streamed + sharded: row_entity holds GLOBAL block-row indices,
+            # so the coefficient table and support layout must be the GLOBAL
+            # ones (blocks.proj_cols covers only this host's range)
+            proj = (
+                np.asarray(ds.host_proj_cols)
+                if ds.entity_shard_range is not None
+                else np.asarray(ds.blocks.proj_cols)
+            )
             scores, cache = score_streamed(
                 vals,
-                np.asarray(ds.blocks.proj_cols),
+                proj,
                 ds.row_entity,
                 ds.ell_idx,
                 ds.ell_val,
@@ -688,6 +775,19 @@ class RandomEffectCoordinate(Coordinate):
                 score_dtype=jnp.promote_types(ds.ell_val.dtype, sdt),
             )
             object.__setattr__(ds, "_stream_xsub_cache", cache)
+            if ds.entity_shard_range is not None:
+                # local row scores -> global row-sharded vector (each host
+                # contributed exactly its padded row slice)
+                from jax.sharding import PartitionSpec
+                from ..parallel import multihost
+                from ..parallel.mesh import DATA_AXIS
+
+                local = np.asarray(
+                    logged_fetch("coordinate.stream_score", scores)
+                )
+                scores = multihost.put_global(
+                    local, ds.mesh, PartitionSpec(DATA_AXIS)
+                )
             return scores
         row_entity = self.dataset.row_entity
         # The model's entity-row order may differ from this dataset's block
@@ -756,7 +856,12 @@ def _pow2_ceil(x: np.ndarray) -> np.ndarray:
     return np.int64(1) << np.frexp(v.astype(np.float64))[1].astype(np.int64)
 
 
-def _size_buckets(dataset: RandomEffectDataset, min_dim: int = 8, align: int = 1):
+def _size_buckets(
+    dataset: RandomEffectDataset,
+    min_dim: int = 8,
+    align: int = 1,
+    entity_range: Optional[Tuple[int, int]] = None,
+):
     """Contiguous entity segments with power-of-2-rounded (K, S) block shapes.
 
     Returns [(start, end, K_b, S_b)], or None when per-entity stats are
@@ -775,6 +880,15 @@ def _size_buckets(dataset: RandomEffectDataset, min_dim: int = 8, align: int = 1
     svec = dataset.entity_subspace_dims
     if counts is None or svec is None or len(counts) == 0:
         return None
+    if entity_range is not None:
+        # streamed + sharded: stats are GLOBAL but the blocks hold only this
+        # host's [lo, hi) range — bucket the local slice (counts are globally
+        # non-increasing, so the slice stays sorted)
+        lo, hi = entity_range
+        counts = counts[lo:hi]
+        svec = svec[lo:hi]
+        if len(counts) == 0:
+            return None
     E, K, S = dataset.blocks.features.shape
 
     kb_of = np.minimum(
@@ -840,6 +954,20 @@ def _concat_results(parts, S: int) -> SolverResult:
     )
 
 
+def _concat_results_np(parts) -> SolverResult:
+    """Stitch per-host streamed SolverResults (host numpy) into the global
+    entity order — process order == entity order because the streamed entity
+    shard ranges are contiguous and ascending by process."""
+    if len(parts) == 1:
+        return parts[0]
+    return SolverResult(
+        **{
+            f.name: np.concatenate([np.asarray(getattr(p, f.name)) for p in parts])
+            for f in dataclasses.fields(SolverResult)
+        }
+    )
+
+
 def _project_model_values(
     dataset: RandomEffectDataset, model: RandomEffectModel, values, dtype,
     to_device: bool = True,
@@ -850,12 +978,14 @@ def _project_model_values(
     result in host numpy (streamed datasets must not materialize [E, S] on
     device)."""
     blocks = dataset.blocks
-    E, S = blocks.proj_cols.shape
-    # multi-process: blocks.proj_cols is entity-sharded (not host-addressable);
-    # the dataset carries a host copy for layout checks and projection
+    # multi-process: blocks.proj_cols is entity-sharded (not host-addressable)
+    # or, streamed+sharded, holds only the local block-row range; the dataset
+    # carries a GLOBAL host copy for layout checks and projection — shapes
+    # derive from it so the projection is always in the global entity layout
     pc_host = dataset.host_proj_cols
     if pc_host is None:
         pc_host = logged_fetch("coordinate.project_layout", blocks.proj_cols)
+    E, S = np.shape(pc_host)
     idx = np.asarray(
         logged_fetch("coordinate.project_layout", model.coef_indices)
     )

@@ -103,6 +103,10 @@ class FixedEffectDataset:
     host_batch: Optional[HostRowBatch] = None
     streamed: bool = False
     hbm_budget_bytes: Optional[int] = None
+    # streamed + mesh/multi-process: host_batch holds THIS host's row slice;
+    # the mesh is kept so scoring can reassemble the global row-sharded
+    # score vector (n_rows stays the LOCAL true row count)
+    mesh: Optional[object] = None
 
     @property
     def n_rows(self) -> int:
@@ -172,6 +176,12 @@ class RandomEffectDataset:
     # (reference: DISK_ONLY spill, CoordinateDescent.scala:262,404)
     streamed: bool = False
     hbm_budget_bytes: Optional[int] = None
+    # streamed + multi-process (game/data_mp.py): blocks hold only THIS
+    # host's contiguous [lo, hi) block-row range; entity-level host tables
+    # (entity_ids / counts / host_proj_cols) stay GLOBAL. ``mesh`` is kept so
+    # scoring can reassemble the global row-sharded score vector.
+    entity_shard_range: Optional[Tuple[int, int]] = None
+    mesh: Optional[object] = None
 
     @property
     def num_entities(self) -> int:
@@ -273,10 +283,12 @@ def build_fixed_effect_dataset(
     """``hbm_budget_bytes``: when set and the resident device batch would
     exceed this many bytes, the dataset is built STREAMED — features stay in
     host numpy (dense or ELL rows) and training/scoring stream row slices
-    (game/fe_streaming.py). Streaming composes with neither the mesh nor the
-    coo/tiled layouts (refused by GameEstimator before this point)."""
+    (game/fe_streaming.py). Under a mesh / multi-process topology ``raw`` is
+    this host's row slice, so the budget governs the PER-HOST stream (the
+    planner's streamed+sharded routing); the coo/tiled layouts are refused by
+    the execution planner before this point."""
     d = raw.shard_dims[feature_shard]
-    if hbm_budget_bytes is not None and mesh is None:
+    if hbm_budget_bytes is not None:
         eff_layout = layout
         if eff_layout == "auto":
             # same rule as RawDataset.to_batch's auto resolution
@@ -323,15 +335,26 @@ def build_fixed_effect_dataset(
                     ell_idx=ell_idx,
                     ell_val=ell_val.astype(fdt),
                 )
+            # multi-process: the coordinate's row space is the padded GLOBAL
+            # row space (scores/residuals stay [N_global], matching the
+            # resident multi-process batch); host_batch keeps the LOCAL rows
+            n_true = n
+            if mesh is not None and jax.process_count() > 1:
+                from ..parallel.mesh import DATA_AXIS
+
+                n_proc = jax.process_count()
+                chunk = max(mesh.shape[DATA_AXIS] // n_proc, 1)
+                n_true = (-(-n // chunk) * chunk) * n_proc
             return FixedEffectDataset(
                 coordinate_id=coordinate_id,
                 feature_shard=feature_shard,
                 batch=None,
                 true_dim=d,
-                true_n_rows=n,
+                true_n_rows=n_true,
                 host_batch=host,
                 streamed=True,
                 hbm_budget_bytes=hbm_budget_bytes,
+                mesh=mesh,
             )
     return FixedEffectDataset(
         coordinate_id=coordinate_id,

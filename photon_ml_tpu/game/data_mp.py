@@ -82,6 +82,7 @@ def build_random_effect_dataset_global(
     pad_entities_to_multiple: int = 1,
     features_to_samples_ratio: Optional[float] = None,
     feature_dtype=None,
+    hbm_budget_bytes: Optional[int] = None,
 ) -> RandomEffectDataset:
     """Build a RandomEffectDataset whose row axis spans ALL processes' rows.
 
@@ -89,6 +90,15 @@ def build_random_effect_dataset_global(
     resulting dataset's sample space is the padded GLOBAL row space
     [P * raw.n_rows], row-sharded over the mesh data axis, and the entity
     blocks are entity-sharded over the same axis.
+
+    ``hbm_budget_bytes``: when set and this host's entity shard would exceed
+    the budget, the dataset is built STREAMED — each process keeps only ITS
+    contiguous block-row range as HOST numpy (``entity_shard_range`` marks
+    the range) and training/scoring stream entity slices under the PER-HOST
+    budget (game/streaming.py; the execution planner's streamed+sharded
+    routing). Caveat: the build itself still stages the full blocks through
+    device memory — the budget bounds steady-state training residency, not
+    peak build residency.
     """
     if jax.process_count() > 1 and raw.global_row_start is None:
         raise ValueError(
@@ -281,18 +291,62 @@ def build_random_effect_dataset_global(
     # --- 6. assemble (downcast wide staging to the block dtype; features and
     # ELL values optionally narrower via feature_dtype) -----------------------
     fdt = feature_dtype or dtype
-    if build_dtype != np_dtype or feature_dtype is not None:
-        feats = feats.astype(fdt)
-        lb = lb.astype(dtype)
-        elv_g = elv_g.astype(fdt)
-    blocks = EntityBlocks(
-        features=feats,
-        labels=lb,
-        offsets=ob.astype(dtype),
-        weights=wb.astype(dtype),
-        proj_cols=pc,
-        active_rows=active_rows,
-    )
+    fdt_np = np.dtype(jnp.zeros((), fdt).dtype)
+    streamed = False
+    if hbm_budget_bytes is not None:
+        from .streaming import estimate_block_bytes
+
+        # per-HOST budget against this host's entity shard (same estimator
+        # as the single-process build, scaled to the local share of E)
+        streamed = (
+            estimate_block_bytes(-(-E // n_proc), K, int(pc.shape[1]), fdt_np.itemsize)
+            > hbm_budget_bytes
+        )
+    entity_shard_range = None
+    if streamed:
+        # streamed + sharded: pull THIS host's contiguous block-row range to
+        # host numpy; train/score stream it in budget-sized slices
+        # (game/streaming.py) and exchange results host-side in process order
+        shard_keys = sorted(
+            {
+                (s.index[0].start or 0, s.index[0].stop)
+                for s in active_rows.addressable_shards
+            }
+        )
+        lo = int(shard_keys[0][0])
+        hi = int(shard_keys[-1][1]) if shard_keys[-1][1] is not None else E
+        entity_shard_range = (lo, hi)
+        pull = multihost.host_local_rows
+        blocks = EntityBlocks(
+            features=pull(feats).astype(fdt_np),
+            labels=pull(lb).astype(np_dtype),
+            offsets=pull(ob).astype(np_dtype),
+            weights=pull(wb).astype(np_dtype),
+            proj_cols=pull(pc).astype(np.int32),
+            active_rows=pull(active_rows).astype(np.int32),
+        )
+        # scoring arrays stay LOCAL (this host's padded row slice, plain
+        # single-device arrays): the streamed score computes local scores
+        # and put_globals them into the global row space
+        row_entity_out = jnp.asarray(ent_local)
+        ell_idx_out = jnp.asarray(ell_idx_l)
+        ell_val_out = jnp.asarray(ell_val_l.astype(fdt_np))
+    else:
+        if build_dtype != np_dtype or feature_dtype is not None:
+            feats = feats.astype(fdt)
+            lb = lb.astype(dtype)
+            elv_g = elv_g.astype(fdt)
+        blocks = EntityBlocks(
+            features=feats,
+            labels=lb,
+            offsets=ob.astype(dtype),
+            weights=wb.astype(dtype),
+            proj_cols=pc,
+            active_rows=active_rows,
+        )
+        row_entity_out = ent_g
+        ell_idx_out = eli_g
+        ell_val_out = elv_g
     kept_ids = uniq[plan.kept_entities].astype(str)
     entity_ids = (
         np.concatenate(
@@ -310,9 +364,9 @@ def build_random_effect_dataset_global(
         random_effect_type=random_effect_type,
         entity_ids=entity_ids.astype(object),
         blocks=blocks,
-        row_entity=ent_g,
-        ell_idx=eli_g,
-        ell_val=elv_g,
+        row_entity=row_entity_out,
+        ell_idx=ell_idx_out,
+        ell_val=ell_val_out,
         # per-entity passive/active accounting (RandomEffectDataset.scala:
         # 590-599): global rows that belong to a kept entity but were
         # reservoir-dropped from its active block. Derived from the
@@ -322,6 +376,10 @@ def build_random_effect_dataset_global(
         entity_counts=entity_counts,
         entity_subspace_dims=sizes_host,
         host_proj_cols=host_pc,
+        streamed=streamed,
+        hbm_budget_bytes=hbm_budget_bytes if streamed else None,
+        entity_shard_range=entity_shard_range,
+        mesh=mesh if streamed else None,
     )
 
 

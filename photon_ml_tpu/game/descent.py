@@ -35,6 +35,17 @@ from .coordinate import Coordinate, ModelCoordinate
 logger = logging.getLogger("photon_ml_tpu")
 
 
+def _process_count() -> int:
+    """Process count without requiring an initialized backend (host-only
+    callers — planner dry runs, unit tests with jax stubbed out — see 1)."""
+    try:
+        import jax
+
+        return jax.process_count()
+    except Exception:  # photon: ignore[R4] - no-jax fallback, single process
+        return 1
+
+
 def _local_devices():
     """Device handles for memory sampling; empty when the backend is not up
     (sampling then covers host RSS only)."""
@@ -263,7 +274,16 @@ class CoordinateDescent:
                     self.pipeline_depth > 1
                     and self.validation is not None
                     and self.validation_frequency == "COORDINATE"
+                    and _process_count() == 1
                 ):
+                    # multi-process runs keep validation eval on the main
+                    # thread: every process must enqueue device computations
+                    # (and any collectives hiding in sharded score fns) in
+                    # the SAME order, and a background eval thread interleaves
+                    # its dispatches nondeterministically against the solve
+                    # stream — a cross-host ordering mismatch is a deadlock.
+                    # Depth >= 2 still pipelines the score-sum dispatch ahead
+                    # of the guard fetch and the streaming slice prefetch.
                     lane = pipeline.EvalLane(
                         self._evaluate,
                         capacity=self.pipeline_depth - 1,

@@ -38,9 +38,14 @@ construction, pad rows carry weight 0 and are invisible to the objective),
 so each kernel compiles once per (layout, step, d) — no per-remainder
 recompiles.
 
-Single-process by design, like the RE stream: streaming is the scale-up
-story for one chip's HBM; mesh sharding (layout=tiled) is the scale-out
-story. ``GameEstimator`` refuses the composition.
+Streaming composes with the mesh / multi-process topology (the execution
+planner's streamed+sharded routing, plan/planner.py): each host streams ITS
+OWN row slice under the per-host budget — the seqOp stays local — and the
+combOp grows one cross-host rung: the accumulated per-pass partial sums
+(O(d), not O(n*d)) are exchanged host-side in process order before the
+finalize kernels, exactly where the reference's treeAggregate combined
+executor partials on the driver. Single-process, that rung is a no-op and
+the math is bit-identical to the resident path up to float summation order.
 """
 
 from __future__ import annotations
@@ -270,6 +275,10 @@ class StreamedFEObjective:
         self.pipeline_depth = (
             pipeline.active_depth() if pipeline_depth is None else int(pipeline_depth)
         )
+        # multi-process: each host streams its OWN row slice; the per-pass
+        # O(d) partial sums are combined across hosts before finalize (the
+        # treeAggregate combOp rung — see module docstring)
+        self._cross_host = jax.process_count() > 1
         self._anchor = pipeline.stage_anchor()
         self._slice_cost = self.step * row_bytes
         self._prefetch: Optional[PrefetchQueue] = None
@@ -338,6 +347,23 @@ class StreamedFEObjective:
 
     # -- objective ------------------------------------------------------------
 
+    def _combine_partials(self, acc):
+        """Sum this pass's accumulated partials across processes (multi-host
+        combOp). Each host's acc covers only its own rows; the exchange is
+        host-side (allgather of O(d) arrays) and summed in process order, so
+        every host computes the identical totals deterministically.
+        Single-process: identity."""
+        if not self._cross_host:
+            return acc
+        from ..parallel import multihost
+
+        local = tuple(logged_fetch("fe_streaming.cross_host", a) for a in acc)
+        parts = multihost.allgather_object(local)
+        totals = list(parts[0])
+        for p in parts[1:]:
+            totals = [t + q for t, q in zip(totals, p)]
+        return tuple(jnp.asarray(t) for t in totals)
+
     def _collect(self, kind: str, out):
         """The pass's single blocking fetch, wrapped in a phase="collect"
         span so the overlap ratio can measure staging hidden under it."""
@@ -368,6 +394,7 @@ class StreamedFEObjective:
                     staged = self._acquire(k + 1)  # overlaps slice k
                 # fixed left-to-right accumulation: bitwise-stable run-to-run
                 acc = part if acc is None else tuple(a + p for a, p in zip(acc, part))
+            acc = self._combine_partials(acc)
             value, grad = _finalize_vg_kernel(
                 coef, acc[0], acc[1], acc[2], self.norm, self._l2, self._pm, self._pp
             )
@@ -397,6 +424,7 @@ class StreamedFEObjective:
                 if k + 1 < self.n_slices:
                     staged = self._acquire(k + 1)
                 acc = part if acc is None else tuple(a + p for a, p in zip(acc, part))
+            acc = self._combine_partials(acc)
             hv = _finalize_hvp_kernel(vv, acc[0], acc[1], self.norm, self._l2, self._pp)
         self._intervals["pass"].append((pp.start_perf, pp.start_perf + pp.duration_s))
         (hv,) = self._collect("hvp", (hv,))

@@ -45,64 +45,20 @@ _DIVERGED = int(ConvergenceReason.NUMERICAL_DIVERGENCE.value)
 
 
 def check_lane_composition(estimator, n_lanes: int, distributed: bool = False):
-    """Refuse compositions the lane path does not support. Every message is
-    pinned verbatim in the README support matrix and
-    tests/test_support_matrix.py — keep them stable."""
-    if n_lanes < 1:
-        raise ValueError(f"trial-lanes must be >= 1: {n_lanes}")
-    if estimator.mesh is not None:
-        raise ValueError(
-            "trial-lanes sweeps are single-chip: not composable with a "
-            "device mesh (the lane axis already fills the chip; shard "
-            "trials across hosts instead)"
-        )
-    if distributed or jax.process_count() > 1:
-        raise ValueError(
-            "trial-lanes sweeps are single-process: not composable with "
-            "multi-process training"
-        )
-    if estimator.pipeline_depth > 1:
-        raise ValueError(
-            "trial-lanes sweeps drive their own lane schedule: not "
-            "composable with pipeline_depth > 1"
-        )
-    if estimator.partial_retrain_locked:
-        raise ValueError(
-            "partial retraining (locked coordinates) is not supported "
-            "with trial-lanes"
-        )
-    for cc in estimator.coordinate_configs:
-        where = f"coordinate {cc.name}"
-        if cc.hbm_budget_mb is not None:
-            raise ValueError(
-                f"{where}: trial-lanes sweeps require HBM-resident "
-                "coordinates (hbm_budget_mb streams the data; the lane "
-                "axis multiplies its residency)"
-            )
-        if cc.config.regularization.reg_type in ("L1", "ELASTIC_NET"):
-            raise ValueError(
-                f"{where}: trial-lanes sweeps support L2 regularization "
-                "only (the OWL-QN l1 weight is compile-time static, not a "
-                "per-lane operand)"
-            )
-        if cc.config.variance_type.upper() != "NONE":
-            raise ValueError(
-                f"{where}: trial-lanes sweeps require variance=NONE"
-            )
-        if cc.config.down_sampling_rate < 1.0:
-            raise ValueError(
-                f"{where}: down-sampling is not supported with trial-lanes"
-            )
-        if cc.normalization is not None:
-            raise ValueError(
-                f"{where}: feature normalization is not supported with "
-                "trial-lanes"
-            )
-        if cc.regularize_by_prior:
-            raise ValueError(
-                f"{where}: regularize-by-prior is not supported with "
-                "trial-lanes"
-            )
+    """Refuse compositions the lane path does not support — delegates to the
+    execution planner (plan/planner.py), which owns every ledger-pinned
+    composition-legality message."""
+    from ..plan import check_lane_composition as _check
+
+    _check(
+        estimator.coordinate_configs,
+        n_lanes,
+        mesh=estimator.mesh,
+        n_processes=jax.process_count(),
+        distributed=distributed,
+        pipeline_depth=estimator.pipeline_depth,
+        partial_retrain_locked=tuple(estimator.partial_retrain_locked),
+    )
 
 
 def _lane_model(estimator, cc, coord, coeffs: Array, lane: int):

@@ -23,11 +23,15 @@ lane economy. Scoring streams the per-entity coefficient table through the
 chip the same way (the model itself is bigger than the budget by
 assumption).
 
-Single-process by design: multi-process GLMix shards entities ACROSS hosts
-(game/data_mp.py) — streaming is the scale-up story for one chip's HBM,
-sharding is the scale-out story. The two compose at the estimator level
-(each host streams its own entity shard) but that composition is not wired
-yet; ``GameEstimator`` refuses streamed + multiprocess.
+Composes with multi-process sharding (the execution planner's
+streamed+sharded routing, plan/planner.py): multi-process GLMix shards
+entities ACROSS hosts (game/data_mp.py), and when the per-host entity shard
+still exceeds ``hbm_budget_bytes`` each host keeps ITS contiguous block-row
+range host-resident and streams it through this module under the PER-HOST
+budget. Per-host results are exchanged host-side in process order
+(coordinate._train_streamed), so streaming scales UP each host's share while
+sharding scales OUT across hosts — total coefficient capacity is
+P hosts x (host RAM), beyond any single-host resident configuration.
 """
 
 from __future__ import annotations

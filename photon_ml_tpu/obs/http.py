@@ -77,6 +77,12 @@ def compose_statusz(
     doc: dict = {"status": "ok", "unix_time": time.time()}
     doc.update(run.status.snapshot())
 
+    # the resolved execution plan (per-coordinate routing) when the driver
+    # attached one — the live counterpart of run_summary.json's "plan" block
+    plan = getattr(run, "execution_plan", None)
+    if plan:
+        doc["plan"] = plan
+
     rejections = _sum_counter(snap, "photon_coordinate_rejections_total", "coordinate")
     if rejections:
         doc["coordinate_rejections"] = {k: int(v) for k, v in rejections.items()}

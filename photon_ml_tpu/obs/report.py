@@ -31,7 +31,9 @@ from ..robust.atomic import atomic_write, atomic_write_json
 from . import diagnostics
 from .memory import memory_block
 
-REPORT_SCHEMA_VERSION = 1
+# v2: added the top-level "plan" key (the resolved execution plan from
+# run_summary.json; None for runs that predate the planner)
+REPORT_SCHEMA_VERSION = 2
 REPORT_JSON = "report.json"
 REPORT_HTML = "report.html"
 
@@ -387,6 +389,7 @@ def build_report(inputs: ReportInputs, top_k: int = 20) -> dict:
         "models": models,
         "convergence": convergence,
         "performance": performance,
+        "plan": rs.get("plan"),
         "memory": memory,
         "checkpoints": [
             {
@@ -532,6 +535,41 @@ def render_html(report: dict) -> str:
     if perf.get("aborted"):
         kv.append('<span class="aborted">run aborted mid-sweep</span>')
     parts.append(f'<p class="kv">{"".join(kv)}</p>')
+
+    # -- execution plan ----------------------------------------------------
+    plan = report.get("plan") or {}
+    if plan.get("coordinates"):
+        parts.append("<h2>Execution plan</h2>")
+        mesh = plan.get("mesh_axes") or {}
+        topo = [
+            f"<span>processes <b>{_fmt(plan.get('n_processes'))}</b></span>",
+            "<span>mesh <b>"
+            + (_esc(" ".join(f"{k}={v}" for k, v in mesh.items()))
+               if mesh else "none (single device)")
+            + "</b></span>",
+            f"<span>pipeline depth <b>{_fmt(plan.get('pipeline_depth'))}</b></span>",
+            f"<span>trial lanes <b>{_fmt(plan.get('trial_lanes'))}</b></span>",
+        ]
+        parts.append(f'<p class="kv">{"".join(topo)}</p>')
+        rows = [
+            [
+                _esc(c.get("name")),
+                _esc(c.get("kind")),
+                _esc(c.get("layout")),
+                _fmt(c.get("feature_dtype")),
+                _esc(c.get("residency")),
+                _esc(c.get("sharding")),
+                "yes" if c.get("pipelined") else "no",
+            ]
+            for c in plan["coordinates"]
+        ]
+        parts.append(
+            _table(
+                ["coordinate", "kind", "layout", "dtype", "residency",
+                 "routing", "pipelined"],
+                rows,
+            )
+        )
 
     # -- memory ------------------------------------------------------------
     memory = report.get("memory") or {}

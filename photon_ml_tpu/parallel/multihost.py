@@ -118,6 +118,29 @@ def put_global(local: np.ndarray, mesh: Mesh, spec: P) -> jax.Array:
     return jax.make_array_from_process_local_data(sharding, np.asarray(local))
 
 
+def host_local_rows(arr: jax.Array) -> np.ndarray:
+    """This process's contiguous block of a dim-0-sharded global array, as
+    host numpy (the inverse of :func:`put_global` for the local slice).
+
+    The streamed+sharded routing uses this to hand each host ITS rows /
+    entities of a global array for host-resident streaming: addressable
+    shards are concatenated in dim-0 index order, so the result is exactly
+    the local block this process contributed. Replicated (or single-process)
+    arrays come back whole."""
+    shards = sorted(
+        arr.addressable_shards, key=lambda s: (s.index[0].start or 0)
+    )
+    parts = []
+    seen = set()
+    for s in shards:
+        key = (s.index[0].start or 0, s.index[0].stop)
+        if key in seen:  # replicated over other axes: one copy per block
+            continue
+        seen.add(key)
+        parts.append(jax.device_get(s.data))
+    return parts[0] if len(parts) == 1 else np.concatenate(parts, axis=0)
+
+
 def equal_host_share(n_rows: int, count: Optional[int] = None) -> int:
     """The common per-host row count every process pads its share to:
     ``ceil(n_rows / P)``. All hosts must contribute equal local shapes to

@@ -1,0 +1,32 @@
+"""Execution-plan layer: one planner for the whole scale configuration.
+
+Reference: Spark builds ONE physical plan per job — Catalyst composes the
+shuffle, spill and partitioning decisions before any task runs
+(CoordinateDescent.scala:262,404 rides on that plan). The port grew each
+scale mechanism independently (layouts, dtypes, mesh axes, multi-process,
+HBM-budget streaming, sweep pipelining, trial lanes) and their legality
+logic was scattered across five modules. This package is the single place
+that composes them: :func:`resolve` maps the full per-coordinate
+configuration to a typed, introspectable :class:`ExecutionPlan` — or raises
+one typed :class:`PlanError` carrying the ledger-pinned refusal message.
+"""
+
+from .planner import (
+    CoordinatePlan,
+    ExecutionPlan,
+    PlanError,
+    check_lane_composition,
+    check_multiprocess_mesh,
+    check_retrain_composition,
+    resolve,
+)
+
+__all__ = [
+    "CoordinatePlan",
+    "ExecutionPlan",
+    "PlanError",
+    "check_lane_composition",
+    "check_multiprocess_mesh",
+    "check_retrain_composition",
+    "resolve",
+]

@@ -203,6 +203,22 @@ def _make_artifacts(root):
                     }
                 },
                 "metrics": final,
+                "plan": {
+                    "coordinates": [
+                        {"name": "global", "kind": "fixed-effect",
+                         "layout": "auto", "feature_dtype": None,
+                         "residency": "streamed",
+                         "sharding": "host-sharded rows (streamed slices)",
+                         "pipelined": True, "hbm_budget_mb": 0,
+                         "geometry": {}, "notes": []},
+                    ],
+                    "mesh_axes": {"data": 8, "model": 1},
+                    "n_processes": 2,
+                    "pipeline_depth": 2,
+                    "trial_lanes": 1,
+                    "normalization": "NONE",
+                    "distributed": True,
+                },
                 "memory": {"host": {"rss_bytes": 1000, "peak_rss_bytes": 2000}},
                 "timeline": {
                     "n_sweeps": 2,
@@ -254,12 +270,21 @@ def test_report_json_golden_schema(tmp_path):
     root = _make_artifacts(str(tmp_path / "artifacts"))
     doc = report_cli.run([root, "--out", str(tmp_path / "rep")])
 
-    assert doc["schema_version"] == 1
+    assert doc["schema_version"] == 2
     assert set(doc) == {
         "schema_version", "task", "best", "models", "convergence",
-        "performance", "memory", "checkpoints", "bench",
+        "performance", "plan", "memory", "checkpoints", "bench",
     }
     assert doc["task"] == "logistic_regression"
+
+    # v2: the resolved execution plan rides along verbatim from
+    # run_summary.json (None when the run predates the planner)
+    plan = doc["plan"]
+    assert plan["n_processes"] == 2 and plan["mesh_axes"] == {"data": 8,
+                                                             "model": 1}
+    (cp,) = plan["coordinates"]
+    assert cp["residency"] == "streamed"
+    assert cp["sharding"] == "host-sharded rows (streamed slices)"
 
     assert set(doc["models"]) == {"best"}
     model = doc["models"]["best"]

@@ -111,7 +111,9 @@ def test_streamed_warm_start_and_prior(raw, monkeypatch):
 
 def test_estimator_streamed_fixed_policy_and_mesh():
     """A streamed FIXED effect is now supported — but only on row-sliceable
-    layouts, variance NONE, full sampling, and without a mesh."""
+    layouts, variance NONE, and full sampling. Streamed × mesh is legal
+    since the plan layer: the planner routes streamed FE to host-sharded
+    row slices and streamed RE to host-resident entity blocks."""
     import dataclasses
 
     from photon_ml_tpu.estimators.game_estimator import CoordinateConfig, GameEstimator
@@ -159,21 +161,24 @@ def test_estimator_streamed_fixed_policy_and_mesh():
                 )
             ],
         )
-    for extra in (
-        dict(),  # fixed effect
-        dict(random_effect_type="userId"),  # random effect
+    for extra, routing in (
+        (dict(), "host-sharded rows (streamed slices)"),  # fixed effect
+        (dict(random_effect_type="userId"),  # random effect
+         "entity-sharded (host-resident blocks)"),
     ):
-        with pytest.raises(ValueError, match="not composable"):
-            GameEstimator(
-                task="logistic_regression",
-                coordinate_configs=[
-                    CoordinateConfig(
-                        name="c", feature_shard="s", config=cfg,
-                        hbm_budget_mb=64, **extra,
-                    )
-                ],
-                mesh=make_mesh(n_data=8),
-            )
+        est = GameEstimator(
+            task="logistic_regression",
+            coordinate_configs=[
+                CoordinateConfig(
+                    name="c", feature_shard="s", config=cfg,
+                    hbm_budget_mb=64, **extra,
+                )
+            ],
+            mesh=make_mesh(n_data=8),
+        )
+        (cplan,) = est.execution_plan.coordinates
+        assert cplan.residency == "streamed"
+        assert cplan.sharding == routing
 
 
 def test_cli_trains_streamed_re_with_parity(tmp_path):

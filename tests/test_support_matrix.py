@@ -26,6 +26,7 @@ from photon_ml_tpu.ops.glm import MAX_FULL_VARIANCE_DIM, check_full_variance_dim
 from photon_ml_tpu.ops.normalization import build_normalization
 from photon_ml_tpu.ops.regularization import RegularizationContext
 from photon_ml_tpu.parallel import mesh as mesh_mod
+from photon_ml_tpu.plan import PlanError
 from photon_ml_tpu.testing import generate_mixed_effect_data
 from photon_ml_tpu.testing.generators import mixed_data_to_raw_dataset
 
@@ -106,12 +107,6 @@ def _trigger_streamed_fe_deep_variance(raw):
     ).run_streamed(None, 1 << 20)
 
 
-def _trigger_streamed_and_mesh(raw):
-    _estimator(
-        [_fe(hbm_budget_mb=1)], mesh=mesh_mod.make_mesh(n_data=len(jax.devices()))
-    )
-
-
 def _trigger_full_variance_ceiling(raw):
     check_full_variance_dim(MAX_FULL_VARIANCE_DIM + 1)
 
@@ -134,6 +129,12 @@ def _trigger_multiprocess_ell(raw, monkeypatch):
     mesh_mod.shard_batch(batch, mesh_mod.make_mesh(n_data=len(jax.devices())))
 
 
+def _trigger_multiprocess_no_mesh(raw):
+    from photon_ml_tpu.plan import check_multiprocess_mesh
+
+    check_multiprocess_mesh(2, None)
+
+
 def _trigger_multiprocess_model_axis(raw, monkeypatch):
     monkeypatch.setattr(jax, "process_count", lambda: 2)
     mesh_mod.shard_coefficients(
@@ -145,12 +146,6 @@ def _trigger_serving_width_ladder(raw):
     from photon_ml_tpu.serving.engine import LADDER_WIDTH, _ladder_width
 
     _ladder_width(LADDER_WIDTH[-1] + 1)
-
-
-def _trigger_pipeline_distributed(raw):
-    from photon_ml_tpu.cli.params import check_pipeline_composition
-
-    check_pipeline_composition(2, distributed=True)
 
 
 def _trigger_disk_slice_bad_layout(raw, tmp_path):
@@ -331,21 +326,21 @@ CASES = [
         "retrain-distributed",
         "incremental retrain is single-process: not composable with "
         "--distributed",
-        ValueError,
+        PlanError,
         _trigger_retrain_distributed,
     ),
     (
         "retrain-trial-lanes",
         "incremental retrain warm-starts with regularize-by-prior: not "
         "composable with --trial-lanes",
-        ValueError,
+        PlanError,
         _trigger_retrain_trial_lanes,
     ),
     (
         "retrain-streamed",
         "incremental retrain requires HBM-resident coordinates: not "
         "composable with hbm.budget.mb streaming",
-        ValueError,
+        PlanError,
         _trigger_retrain_streamed,
     ),
     (
@@ -358,34 +353,34 @@ CASES = [
         "lanes-mesh",
         "trial-lanes sweeps are single-chip: not composable with a device "
         "mesh",
-        ValueError,
+        PlanError,
         _trigger_lanes_mesh,
     ),
     (
         "lanes-multiprocess",
         "trial-lanes sweeps are single-process: not composable with "
         "multi-process training",
-        ValueError,
+        PlanError,
         _trigger_lanes_multiprocess,
     ),
     (
         "lanes-pipeline",
         "trial-lanes sweeps drive their own lane schedule: not composable "
         "with pipeline_depth > 1",
-        ValueError,
+        PlanError,
         _trigger_lanes_pipeline,
     ),
     (
         "lanes-partial-retrain",
         "partial retraining (locked coordinates) is not supported with "
         "trial-lanes",
-        ValueError,
+        PlanError,
         _trigger_lanes_partial_retrain,
     ),
     (
         "lanes-streamed",
         "trial-lanes sweeps require HBM-resident coordinates",
-        ValueError,
+        PlanError,
         _trigger_lanes_streamed,
     ),
     (
@@ -422,7 +417,7 @@ CASES = [
     (
         "feature-dtype-tiled-estimator",
         "feature_dtype is not supported with layout='tiled'",
-        ValueError,
+        PlanError,
         _trigger_feature_dtype_tiled,
     ),
     (
@@ -453,14 +448,14 @@ CASES = [
         "streamed-fe-variance",
         "is not supported with hbm_budget_mb on a fixed effect "
         "(out-of-core row slices never materialize the Hessian)",
-        ValueError,
+        PlanError,
         _trigger_streamed_fe_variance,
     ),
     (
         "streamed-fe-down-sampling",
         "down_sampling_rate < 1 is not supported with hbm_budget_mb on a "
         "fixed effect",
-        ValueError,
+        PlanError,
         _trigger_streamed_fe_down_sampling,
     ),
     (
@@ -468,12 +463,6 @@ CASES = [
         "not supported on the streamed fixed-effect path",
         ValueError,
         _trigger_streamed_fe_deep_variance,
-    ),
-    (
-        "streamed-and-mesh",
-        "mesh-sharded coordinates are not composable yet",
-        ValueError,
-        _trigger_streamed_and_mesh,
     ),
     (
         "full-variance-ceiling",
@@ -500,6 +489,13 @@ CASES = [
         _trigger_multiprocess_ell,
     ),
     (
+        "multiprocess-no-mesh",
+        "multi-process training requires a device mesh spanning all global "
+        "devices",
+        PlanError,
+        _trigger_multiprocess_no_mesh,
+    ),
+    (
         "multiprocess-model-axis",
         "model-axis sharding across processes is not supported yet",
         NotImplementedError,
@@ -516,12 +512,6 @@ CASES = [
         "unsupported serving store version",
         ValueError,
         _trigger_serving_store_version,
-    ),
-    (
-        "pipeline-depth-distributed",
-        "pipeline.depth=2 is not supported with --distributed",
-        ValueError,
-        _trigger_pipeline_distributed,
     ),
     (
         "socket-and-listen",
