@@ -1243,6 +1243,15 @@ def bench_serving(
         }
 
 
+def _fleet_counter_total(snapshot, name):
+    """Sum of a counter across a (possibly fleet-merged) snapshot."""
+    return sum(
+        float(e["value"])
+        for e in snapshot
+        if e.get("name") == name and e.get("kind") == "counter"
+    )
+
+
 def bench_serving_openloop(
     step_duration_s=2.0,
     d_fixed=1024,
@@ -1269,10 +1278,18 @@ def bench_serving_openloop(
     p99 stays within a bounded factor of the at-knee p99.
 
     value = knee offered QPS; vs_baseline = past-knee admitted p99 / knee
-    p99 (the bounded-degradation factor the overload tests pin)."""
+    p99 (the bounded-degradation factor the overload tests pin).
+
+    The sweep also exercises the fleet plane end to end: the server runs
+    with a live introspection endpoint, a ``FleetAggregator`` scrapes it
+    after every load step (exactly what ``cli fleetz --scrape`` does over an
+    N-replica fleet), and the merged counters must agree bit-exactly with
+    the in-process registry — the single-replica degenerate case of the
+    aggregation-parity contract."""
     import tempfile
 
     from photon_ml_tpu import obs, serving
+    from photon_ml_tpu.obs import fleet as obs_fleet
 
     gm, requests = _serving_workload(
         d_fixed=d_fixed, n_users=n_users, d_re=d_re, unseen_frac=unseen_frac
@@ -1303,7 +1320,12 @@ def bench_serving_openloop(
                 max_batch=max_batch,
                 max_latency_ms=max_latency_ms,
                 max_pending=max_pending,
+                status_port=0,
             )
+            agg = obs_fleet.FleetAggregator(
+                targets=[f"http://127.0.0.1:{server.status_port}"]
+            )
+            fleet_served_totals = []
             try:
                 # warm + capacity probe: a burst of admitted requests with a
                 # generous deadline fills batches toward max_batch and
@@ -1323,6 +1345,13 @@ def bench_serving_openloop(
                         f.result(timeout=60.0)
                     probe_n += len(futs)
                 capacity = probe_n / (time.perf_counter() - t0)
+
+                # baseline scrape: per-step fleet deltas below must exclude
+                # the probe burst's requests
+                agg.scrape_once()
+                fleet_base = _fleet_counter_total(
+                    agg.merged_snapshot(), "photon_serving_requests_total"
+                )
 
                 steps = []
                 per_step_batch = []
@@ -1347,7 +1376,27 @@ def bench_serving_openloop(
                         (b_sum1 - b_sum0) / max(b_cnt1 - b_cnt0, 1)
                     )
                     steps.append(res)
+                    # fleet plane: scrape the server's live endpoint after
+                    # each step; the merged cumulative served total per step
+                    # is the aggregator-side view of the knee sweep
+                    agg.scrape_once()
+                    fleet_served_totals.append(
+                        _fleet_counter_total(
+                            agg.merged_snapshot(),
+                            "photon_serving_requests_total",
+                        )
+                    )
                 sheds = _shed_totals(reg)
+                # aggregation parity (single-replica degenerate case): the
+                # exposition->parse->merge round trip must not perturb
+                # counters by even one count
+                local_served = _fleet_counter_total(
+                    reg.snapshot(), "photon_serving_requests_total"
+                )
+                assert fleet_served_totals[-1] == local_served, (
+                    f"fleet-merged served total {fleet_served_totals[-1]} != "
+                    f"in-process registry total {local_served}"
+                )
             finally:
                 server.close()
 
@@ -1375,6 +1424,27 @@ def bench_serving_openloop(
         )
         batch_trail = "/".join(f"{b:.1f}" for b in per_step_batch)
         shed_str = ",".join(f"{k}={v}" for k, v in sorted(sheds.items())) or "none"
+        # the aggregator's view of the sweep: cumulative scraped totals ->
+        # per-step fleet served rates (the knee as the fleet plane sees it)
+        fleet_step_qps = []
+        prev = fleet_base
+        for total in fleet_served_totals:
+            fleet_step_qps.append((total - prev) / step_duration_s)
+            prev = total
+        fleet_series = {
+            "fleet_knee_offered_qps": round(knee.offered_qps, 1),
+            "fleet_served_qps": round(fleet_step_qps[knee_i], 1),
+            "fleet_scrapes": int(
+                _fleet_counter_total(
+                    agg.merged_snapshot(), "photon_fleet_scrapes_total"
+                )
+            ),
+        }
+        for name in fleet_series:
+            assert not _lower_is_better(name), (
+                f"--diff direction check: fleet series {name!r} must be "
+                "higher-is-better"
+            )
         return {
             "metric": "serving_openloop_knee_qps",
             "value": round(knee.offered_qps, 1),
@@ -1391,7 +1461,9 @@ def bench_serving_openloop(
                 f"{past.offered_qps:.0f}/s offered -> {past.served_qps:.0f}/s "
                 f"served, admitted p99 {past.latency_p99_s * 1e3:.2f}ms = "
                 f"{p99_factor:.2f}x knee, sheds {shed_str}; every refusal "
-                f"counted, zero lost responses)"
+                f"counted, zero lost responses; fleet aggregator scraped "
+                f"/metrics each step, merged served total bit-exact with "
+                f"the in-process registry)"
             ),
             "vs_baseline": round(p99_factor, 2),
             "quadrants": {
@@ -1407,6 +1479,7 @@ def bench_serving_openloop(
                     "p99_over_knee_factor": round(p99_factor, 3),
                     "mean_batch_rows": round(per_step_batch[-1], 2),
                 },
+                "fleet": fleet_series,
             },
         }
 

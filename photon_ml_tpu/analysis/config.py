@@ -54,6 +54,7 @@ DEFAULT_TIMING_STRICT_MODULES: Tuple[str, ...] = (
 DEFAULT_JAX_FREE_MODULES: Tuple[str, ...] = (
     "photon_ml_tpu/obs/*",
     "photon_ml_tpu/cli/report.py",
+    "photon_ml_tpu/cli/fleetz.py",
     "photon_ml_tpu/io/__init__.py",
     "photon_ml_tpu/io/avro.py",
     "photon_ml_tpu/io/index_map.py",

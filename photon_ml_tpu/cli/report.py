@@ -3,7 +3,8 @@
 Rebuilds the training report (report.json + self-contained report.html) from
 a directory of run artifacts — run_summary.json, metrics.jsonl,
 training-summary.json, saved models, feature-index metadata, boundary
-checkpoint MANIFESTs, bench --progress-out JSONL. No jax, no accelerator
+checkpoint MANIFESTs, bench --progress-out JSONL, flight-recorder
+postmortems (flight-<kind>-<seq>.json). No jax, no accelerator
 stack: the whole path is jax-free (lint rule R8), so this runs on a dev box
 against artifacts rsynced off a training host.
 

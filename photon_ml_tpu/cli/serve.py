@@ -110,9 +110,27 @@ def build_parser() -> argparse.ArgumentParser:
     )
     p.add_argument("--poll-seconds", type=float, default=0.2)
     p.add_argument(
+        "--replica-id",
+        default=None,
+        help="this replica's identity in an N-replica serving fleet; stamped "
+        "on every metric line, span and response trace, and used as the "
+        "process lane in fleet-merged timelines (an integer id also sets "
+        "the obs process index)",
+    )
+    p.add_argument(
+        "--slow-request-ms",
+        type=float,
+        default=None,
+        help="log a warning (with trace_id and per-stage breakdown) and "
+        "count photon_serving_slow_requests_total for completed requests "
+        "slower than this threshold",
+    )
+    p.add_argument(
         "--metrics-out",
         default=None,
-        help="directory for the Prometheus exposition written on shutdown",
+        help="directory for telemetry: the Prometheus exposition and the "
+        "metrics.jsonl span/metric stream (fleet-mergeable via cli fleetz), "
+        "plus flight-recorder postmortems under flight/",
     )
     p.add_argument(
         "--status-port",
@@ -166,17 +184,43 @@ def run(argv: Optional[List[str]] = None, stop_event=None):
     if bool(args.serving_root) == bool(args.store_dir):
         raise SystemExit("pass exactly one of --serving-root / --store-dir")
 
+    # fleet identity BEFORE any sink/span exists, so every line carries it
+    if args.replica_id is not None:
+        obs.set_replica_id(args.replica_id)
+        try:
+            # an integer replica id doubles as the trace/JSONL process lane
+            obs.set_process_index(int(args.replica_id))
+        except ValueError:
+            pass  # non-numeric replica names keep lane 0; the replica
+            # label still disambiguates fleet-merged series
+
     run_ctx = obs.RunTelemetry()
+    obs.record_build_info(run_ctx.registry)
+    flight = None
     if args.metrics_out:
         os.makedirs(args.metrics_out, exist_ok=True)
         run_ctx.register_listener(
             obs.PrometheusSink(os.path.join(args.metrics_out, "metrics.prom"))
         )
+        # the JSONL stream is what cli fleetz merges and stitches: every
+        # span (serving.request + per-stage) and the final metrics snapshot
+        run_ctx.register_listener(
+            obs.JsonlSink(os.path.join(args.metrics_out, "metrics.jsonl"))
+        )
+        # anomaly-triggered postmortems: a shed-rate spike past
+        # --overload-shed-threshold dumps the last window of spans/metrics
+        flight = obs.FlightRecorder(
+            os.path.join(args.metrics_out, "flight"),
+            run=run_ctx,
+            shed_rate_threshold=args.overload_shed_threshold,
+        )
+        run_ctx.register_listener(flight)
     with obs.use_run(run_ctx):
         admission = dict(
             max_pending=args.max_pending,
             default_deadline_ms=args.default_deadline_ms,
             overload_shed_threshold=args.overload_shed_threshold,
+            slow_request_ms=args.slow_request_ms,
         )
         if args.serving_root:
             server = serving.ScoringServer(

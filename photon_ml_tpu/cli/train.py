@@ -249,10 +249,12 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument(
         "--metrics-out",
         default=None,
-        help="directory for machine-readable run telemetry (coordinator "
-        "only): metrics.jsonl (one line per span / per-sweep metrics "
-        "flush), metrics.prom (Prometheus text exposition), and "
-        "run_summary.json (total wall time, per-coordinate iteration "
+        help="directory for machine-readable run telemetry: metrics.jsonl "
+        "(one line per span / per-sweep metrics flush; non-coordinator "
+        "processes write metrics.p<i>.jsonl beside it — merge with cli "
+        "fleetz), metrics.prom (Prometheus text exposition), flight/ "
+        "(anomaly-triggered postmortems), and run_summary.json "
+        "(coordinator only: total wall time, per-coordinate iteration "
         "stats, convergence-reason histogram)",
     )
     p.add_argument(
@@ -335,41 +337,64 @@ def run(argv: Optional[List[str]] = None) -> Dict:
     telemetry_on = bool(
         args.metrics_out or args.trace_out or args.status_port is not None
     )
-    if telemetry_on and multihost.is_coordinator():
+    flight = None
+    if telemetry_on:
         from ..utils.compile_cache import install_compile_metrics_hook
 
+        coordinator = multihost.is_coordinator()
+        # every process streams its own telemetry so cli fleetz can merge
+        # the fleet view; the coordinator keeps the bare filenames (all
+        # single-process tooling reads those), peers suffix their lane
+        suffix = "" if coordinator else f".p{multihost.process_index()}"
         run_t = obs.RunTelemetry()
+        obs.record_build_info(run_t.registry)
         if args.metrics_out:
             os.makedirs(args.metrics_out, exist_ok=True)
             metric_sinks = [
-                obs.JsonlSink(os.path.join(args.metrics_out, "metrics.jsonl")),
+                obs.JsonlSink(
+                    os.path.join(args.metrics_out, f"metrics{suffix}.jsonl")
+                ),
                 obs.PrometheusSink(
-                    os.path.join(args.metrics_out, "metrics.prom")
+                    os.path.join(args.metrics_out, f"metrics{suffix}.prom")
                 ),
             ]
-        if args.trace_out:
+            # anomaly postmortems (solver divergence, coordinate rejection,
+            # crash): last window of spans/metrics, one dump per incident
+            flight = obs.FlightRecorder(
+                os.path.join(args.metrics_out, f"flight{suffix}"),
+                run=run_t,
+            )
+            metric_sinks = metric_sinks + [flight]
+        if args.trace_out and coordinator:
             recorder = obs.TimelineRecorder()
             metric_sinks = metric_sinks + [recorder]
         for sink in metric_sinks:
             run_t.register_listener(sink)
         prev_run = obs.set_current_run(run_t)
         install_compile_metrics_hook()
-        if args.status_port is not None:
+        if args.status_port is not None and coordinator:
             status_server = obs.IntrospectionServer(run_t, port=args.status_port)
             logger.info(
                 "introspection endpoints -> http://127.0.0.1:%d/{metrics,"
                 "healthz,statusz}", status_server.port,
             )
-        if args.metrics_out:
+        if args.metrics_out and coordinator:
             logger.info("run telemetry -> %s", args.metrics_out)
     try:
         summary = _run_training(args, run_t, metric_sinks, t_run0, recorder)
-    except BaseException:
+    except BaseException as exc:
         # crash-flush: a mid-sweep abort (including an injected
         # SimulatedKill) still leaves run_summary.json on disk with the
         # partial timeline / phase attribution collected so far, marked
         # "aborted" — the report and post-mortems read it
-        if run_t is not None:
+        if flight is not None:
+            try:
+                flight.trigger(
+                    "crash", detail=f"{type(exc).__name__}: {exc}"
+                )
+            except Exception:
+                obs.swallowed_error("cli.flightrec_crash_dump")
+        if run_t is not None and multihost.is_coordinator():
             try:
                 _write_run_summary(args, run_t, recorder, t_run0, aborted=True)
             except Exception:
@@ -752,7 +777,9 @@ def _run_training(args, run_t, metric_sinks, t_run0, recorder=None) -> Dict:
             "metrics": None if best.evaluation is None else best.evaluation.metrics,
         },
     }
-    if run_t is not None:
+    if run_t is not None and multihost.is_coordinator():
+        # run_summary.json is a fleet-level document (one per run, not per
+        # process); peers contribute via their metrics.p*.jsonl streams
         _write_run_summary(args, run_t, recorder, t_run0, summary=summary)
     if not multihost.is_coordinator():
         # only process 0 writes outputs (the reference's driver-to-HDFS role)

@@ -19,6 +19,8 @@ nothing that would force a device fetch runs. `cli.train --metrics-out DIR`
 wires this up end to end.
 """
 
+from . import fleet
+from .flightrec import FlightRecorder
 from .http import IntrospectionServer, compose_statusz
 from .memory import memory_block, read_host_memory, sample_memory
 from .metrics import (
@@ -33,7 +35,9 @@ from .run import (
     StatusBoard,
     active,
     build_run_summary,
+    collect_build_info,
     current_run,
+    record_build_info,
     record_solver_metrics,
     set_current_run,
     swallowed_error,
@@ -50,12 +54,16 @@ from .tracing import (
     compile_seconds_total,
     current_span,
     get_process_index,
+    get_replica_id,
+    record_span,
     set_process_index,
+    set_replica_id,
     span,
 )
 
 __all__ = [
     "DEFAULT_BUCKETS",
+    "FlightRecorder",
     "IntrospectionServer",
     "MetricsRegistry",
     "MetricsSnapshotEvent",
@@ -71,21 +79,27 @@ __all__ = [
     "add_device_fetch_bytes",
     "add_device_put_bytes",
     "build_run_summary",
+    "collect_build_info",
     "compile_seconds_total",
     "compose_statusz",
     "current_run",
     "current_span",
+    "fleet",
     "get_process_index",
+    "get_replica_id",
     "histogram_quantile",
     "interval_overlap_seconds",
     "overlap_ratio",
     "memory_block",
     "read_host_memory",
+    "record_build_info",
     "record_solver_metrics",
+    "record_span",
     "sample_memory",
     "render_prometheus",
     "set_current_run",
     "set_process_index",
+    "set_replica_id",
     "span",
     "swallowed_error",
     "use_run",

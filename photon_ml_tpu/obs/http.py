@@ -38,6 +38,7 @@ from typing import Dict, Optional
 from .memory import memory_block, read_host_memory
 from .metrics import histogram_quantile
 from .run import RunTelemetry, current_run
+from .tracing import get_process_index, get_replica_id
 
 _QUANTILES = (0.5, 0.95, 0.99)
 
@@ -75,6 +76,12 @@ def compose_statusz(
     served_qps / shed_qps); ``qps`` is the legacy served-rate argument."""
     snap = run.registry.snapshot()
     doc: dict = {"status": "ok", "unix_time": time.time()}
+    # fleet identity: which process/replica this statusz page belongs to —
+    # the aggregator and humans reading N replicas' pages both need it
+    doc["process_index"] = get_process_index()
+    replica = get_replica_id()
+    if replica is not None:
+        doc["replica"] = replica
     doc.update(run.status.snapshot())
 
     # the resolved execution plan (per-coordinate routing) when the driver

@@ -33,7 +33,9 @@ from .memory import memory_block
 
 # v2: added the top-level "plan" key (the resolved execution plan from
 # run_summary.json; None for runs that predate the planner)
-REPORT_SCHEMA_VERSION = 2
+# v3: added the top-level "flight" key (flight-recorder postmortem index;
+# empty for runs with no anomaly dumps)
+REPORT_SCHEMA_VERSION = 3
 REPORT_JSON = "report.json"
 REPORT_HTML = "report.html"
 
@@ -59,6 +61,9 @@ class ReportInputs:
     feature_counts: Dict[str, int] = dataclasses.field(default_factory=dict)
     checkpoint_manifests: List[dict] = dataclasses.field(default_factory=list)
     bench_progress: List[dict] = dataclasses.field(default_factory=list)
+    # flight-recorder postmortems (flight-<kind>-<seq>.json), root-relative
+    # "path" attached so the report links back to the full dump
+    flight_dumps: List[dict] = dataclasses.field(default_factory=list)
 
 
 def _load_json(path: str) -> Optional[dict]:
@@ -125,6 +130,11 @@ def discover(root: str) -> ReportInputs:
                 doc = _load_json(path)
                 if doc and "shard" in doc and "size" in doc:
                     inputs.feature_counts[str(doc["shard"])] = int(doc["size"])
+            elif fname.startswith("flight-") and fname.endswith(".json"):
+                doc = _load_json(path)
+                if doc and "trigger" in doc:
+                    doc["path"] = os.path.relpath(path, root)
+                    inputs.flight_dumps.append(doc)
             elif fname.endswith(".jsonl") and fname != _METRICS_JSONL:
                 rows = _load_bench_progress(path)
                 if rows:
@@ -136,6 +146,10 @@ def discover(root: str) -> ReportInputs:
             name = os.path.relpath(path, root)
         inputs.model_dirs[name] = path
     inputs.checkpoint_manifests.sort(key=lambda m: int(m.get("step", 0)))
+    inputs.flight_dumps.sort(
+        key=lambda d: (float((d.get("trigger") or {}).get("unix_time") or 0.0),
+                       d.get("path", ""))
+    )
     return inputs
 
 
@@ -401,6 +415,18 @@ def build_report(inputs: ReportInputs, top_k: int = 20) -> dict:
             for m in inputs.checkpoint_manifests
         ],
         "bench": {"progress": inputs.bench_progress},
+        "flight": [
+            {
+                "trigger": (d.get("trigger") or {}).get("kind"),
+                "detail": (d.get("trigger") or {}).get("detail"),
+                "unix_time": (d.get("trigger") or {}).get("unix_time"),
+                "process_index": (d.get("identity") or {}).get("process_index"),
+                "replica": (d.get("identity") or {}).get("replica"),
+                "n_events": len(d.get("events") or []),
+                "path": d.get("path"),
+            }
+            for d in inputs.flight_dumps
+        ],
     }
     return report
 
@@ -789,6 +815,27 @@ def render_html(report: dict) -> str:
                     [_esc(name), _fmt(d["old"]), _fmt(d["new"]),
                      _fmt(d["delta_pct"])]
                     for name, d in sorted(diff.items())
+                ],
+            )
+        )
+
+    # -- flight recorder ---------------------------------------------------
+    flight = report.get("flight") or []
+    if flight:
+        parts.append("<h2>Flight recorder</h2>")
+        parts.append(
+            '<p class="aborted">anomaly postmortems were dumped during '
+            "this run</p>"
+        )
+        parts.append(
+            _table(
+                ["trigger", "detail", "process", "replica", "events",
+                 "dump"],
+                [
+                    [_esc(d.get("trigger")), _esc(d.get("detail")),
+                     _fmt(d.get("process_index")), _esc(d.get("replica")),
+                     _fmt(d.get("n_events")), _esc(d.get("path"))]
+                    for d in flight
                 ],
             )
         )

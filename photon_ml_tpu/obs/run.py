@@ -193,6 +193,53 @@ def record_solver_metrics(solver: str, result) -> None:
     ).labels(solver=solver).observe_many(gn.tolist())
 
 
+def collect_build_info() -> Dict[str, str]:
+    """Build/runtime identity of this process: package version, jax version
+    and backend (when a usable jax is present — obs stays importable without
+    one), plus process/replica labels. The values every fleet-merged metric
+    stream must stay attributable to."""
+    from .tracing import get_process_index, get_replica_id
+
+    try:
+        from .. import __version__ as version
+    # photon: ignore[R4] — a version probe must never fail telemetry setup;
+    # the placeholder value IS the degraded-mode signal
+    except Exception:  # pragma: no cover
+        version = "unknown"
+    info = {"version": str(version), "jax": "none", "backend": "none"}
+    try:
+        import jax
+
+        info["jax"] = str(jax.__version__)
+        info["backend"] = str(jax.default_backend())
+    # photon: ignore[R4] — build info is best-effort by design: a jax-free
+    # process (report rebuilds, fleet aggregation) reports backend "none"
+    except Exception:
+        pass
+    info["process"] = str(get_process_index())
+    info["replica"] = get_replica_id() or ""
+    return info
+
+
+def record_build_info(registry: Optional[MetricsRegistry] = None) -> Dict[str, str]:
+    """Stamp the ``photon_build_info`` gauge (value 1, identity in labels)
+    into ``registry`` (default: the current run's), so every Prometheus
+    exposition carries it and merged fleet streams stay attributable."""
+    reg = registry if registry is not None else current_run().registry
+    info = collect_build_info()
+    reg.gauge(
+        "photon_build_info",
+        "build/runtime identity of this process; value is always 1",
+    ).labels(
+        version=info["version"],
+        jax=info["jax"],
+        backend=info["backend"],
+        process=info["process"],
+        replica=info["replica"],
+    ).set(1)
+    return info
+
+
 def build_run_summary(registry: MetricsRegistry, total_wall_seconds: float) -> dict:
     """The ``run_summary.json`` document: total wall time, per-coordinate
     iteration StatCounters and convergence-reason histograms, memory
@@ -216,6 +263,7 @@ def build_run_summary(registry: MetricsRegistry, total_wall_seconds: float) -> d
             coordinates.setdefault(coord, {})["rejections"] = int(m["value"])
     doc = {
         "total_wall_seconds": float(total_wall_seconds),
+        "build": collect_build_info(),
         "coordinates": coordinates,
         "metrics": snap,
     }

@@ -35,7 +35,7 @@ from ..robust.atomic import atomic_write
 from ..utils.events import EventListener
 from .metrics import render_prometheus
 from .run import MetricsSnapshotEvent
-from .tracing import SpanEvent, get_process_index
+from .tracing import SpanEvent, get_process_index, get_replica_id
 
 _HOSTNAME = socket.gethostname()
 
@@ -97,6 +97,9 @@ class JsonlSink(EventListener):
         # multi-process run can be merged and stay attributable; read at
         # write time, robust to set_process_index landing after sink setup
         header = {"process_index": get_process_index(), "host": _HOSTNAME}
+        replica = get_replica_id()
+        if replica is not None:
+            header["replica"] = replica
         if isinstance(event, SpanEvent):
             s = event.span
             return {

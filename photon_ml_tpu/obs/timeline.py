@@ -128,6 +128,18 @@ class TimelineRecorder(EventListener):
             )
             lanes.setdefault((s.process_index, s.thread_id), s.thread_name)
         events.sort(key=lambda e: e["ts"])
+        # wall-clock alignment for cross-process stitching (obs.fleet):
+        # per-process ts comes from perf_counter, whose origin differs per
+        # process; exporting unix-minus-perf lets a stitcher rebase every
+        # process's events onto the one shared wall clock
+        offsets = [
+            s.start_unix - s.start_perf
+            for s in spans
+            if s.start_perf and s.start_unix
+        ]
+        other = {}
+        if offsets:
+            other["unix_minus_perf_s"] = max(offsets)
         meta: List[dict] = []
         for (pid, tid), tname in sorted(lanes.items()):
             meta.append(
@@ -148,7 +160,10 @@ class TimelineRecorder(EventListener):
                     "args": {"name": tname or f"thread {tid}"},
                 }
             )
-        return {"traceEvents": meta + events, "displayTimeUnit": "ms"}
+        doc = {"traceEvents": meta + events, "displayTimeUnit": "ms"}
+        if other:
+            doc["otherData"] = other
+        return doc
 
     def write_chrome_trace(self, path: str) -> None:
         from ..robust.atomic import atomic_write_json

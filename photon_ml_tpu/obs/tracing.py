@@ -43,14 +43,36 @@ _compile_seconds_total = 0.0
 # from parallel.multihost after distributed init); single-process runs keep 0.
 _process_index = 0
 
+# Serving-fleet identity: which replica of an N-replica fleet this process
+# is. Orthogonal to the jax process index (training shards, serving
+# replicates); ``cli serve --replica-id`` pushes it in, spans and JSONL
+# lines stamp it, and the fleet aggregator keys per-replica gauges on it.
+_replica_id: Optional[str] = None
+
 
 def set_process_index(index: int) -> None:
     global _process_index
+    # set once at startup (cli drivers stamp identity BEFORE any sink,
+    # server or recorder thread exists); after that it is read-only, and
+    # CPython reference assignment is atomic — a late reader sees the old
+    # or the new index, never a torn value
+    # photon: thread-confined
     _process_index = int(index)
 
 
 def get_process_index() -> int:
     return _process_index
+
+
+def set_replica_id(replica: Optional[str]) -> None:
+    global _replica_id
+    # same set-once-at-startup discipline as set_process_index above
+    # photon: thread-confined
+    _replica_id = None if replica is None else str(replica)
+
+
+def get_replica_id() -> Optional[str]:
+    return _replica_id
 
 
 def add_compile_seconds(seconds: float) -> None:
@@ -121,10 +143,49 @@ def span(name: str, parent: Optional[Span] = None, **attrs):
         compile_delta = compile_seconds_total() - compile0
         if compile_delta > 0:
             s.attrs["compile_s"] = compile_delta
+        if _replica_id is not None:
+            s.attrs.setdefault("replica", _replica_id)
         _ctx.reset(token)
         run = _run.current_run()
         if run.has_listeners():
             run.send_event(SpanEvent(span=s))
+
+
+def record_span(
+    name: str,
+    start_perf: float,
+    end_perf: float,
+    parent: Optional[Span] = None,
+    **attrs,
+) -> Optional[Span]:
+    """Emit an already-closed span from explicit ``perf_counter`` stamps.
+
+    The serving microbatcher measures per-request stages across threads
+    (enqueue on the caller, drain + score on the worker), so no context
+    manager can bracket them; the worker reconstructs the stage intervals
+    from the cross-thread stamps and emits them here, parented under the
+    request's root span. Free when no sink is listening. ``start_unix`` is
+    back-derived from the wall clock so stitched fleet timelines align."""
+    run = _run.current_run()
+    if not run.has_listeners():
+        return None
+    now_perf = time.perf_counter()
+    s = Span(
+        name=name,
+        span_id=f"s{next(_ids)}",
+        parent_id=parent.span_id if parent is not None else None,
+        start_unix=time.time() - (now_perf - start_perf),
+        attrs=dict(attrs),
+        duration_s=max(0.0, float(end_perf) - float(start_perf)),
+        thread_id=threading.get_ident(),
+        thread_name=threading.current_thread().name,
+        process_index=_process_index,
+        start_perf=float(start_perf),
+    )
+    if _replica_id is not None:
+        s.attrs.setdefault("replica", _replica_id)
+    run.send_event(SpanEvent(span=s))
+    return s
 
 
 def _add_transfer_bytes(direction: str, site: str, nbytes: int) -> None:

@@ -39,6 +39,8 @@ p50/p95/p99 gauges for every histogram family.
 
 from __future__ import annotations
 
+import dataclasses
+import logging
 import queue
 import threading
 import time
@@ -48,6 +50,8 @@ from typing import Callable, List, Optional, Tuple
 from .. import obs
 from ..robust import faults
 from .engine import ScoreEngine, ScoreRequest
+
+logger = logging.getLogger("photon_ml_tpu")
 
 # Serving latencies are sub-millisecond to tens of ms — the seconds-scale
 # DEFAULT_BUCKETS would put every observation in the first bucket and make
@@ -59,6 +63,39 @@ SERVING_LATENCY_BUCKETS: Tuple[float, ...] = (
 
 _SHED_HELP = "requests refused by admission control instead of queued to death"
 _OFFERED_HELP = "requests offered to the batcher (admitted + shed)"
+
+
+@dataclasses.dataclass
+class RequestTrace:
+    """Per-request trace context threaded through the batcher: the request's
+    ``trace_id`` (assigned at socket accept, echoed on every response) and
+    the root span the per-stage spans (``serving.admit`` /
+    ``serving.batch`` / ``serving.score``) parent under. Free when no sink
+    is listening — stage spans are only built for traced requests on an
+    active run."""
+
+    trace_id: str
+    parent: Optional[obs.Span] = None
+
+
+def _stage_span(
+    trace: Optional[RequestTrace],
+    name: str,
+    start_perf: float,
+    end_perf: float,
+    **attrs,
+) -> None:
+    """Emit one per-stage span for a traced request; no-op untraced/passive."""
+    if trace is None or not obs.active():
+        return
+    obs.record_span(
+        name,
+        start_perf,
+        end_perf,
+        parent=trace.parent,
+        trace_id=trace.trace_id,
+        **attrs,
+    )
 
 
 class ShedError(RuntimeError):
@@ -84,6 +121,7 @@ class MicroBatcher:
         max_latency_ms: float = 2.0,
         max_pending: int = 1024,
         ewma_alpha: float = 0.2,
+        slow_request_ms: Optional[float] = None,
     ):
         if max_batch < 1:
             raise ValueError("max_batch must be >= 1")
@@ -94,6 +132,11 @@ class MicroBatcher:
         self.max_latency_s = float(max_latency_ms) / 1e3
         self.max_pending = int(max_pending)
         self._ewma_alpha = float(ewma_alpha)
+        # slow-request threshold (enqueue->scored); None disables the log
+        # line + photon_serving_slow_requests_total counting
+        self.slow_request_s = (
+            None if slow_request_ms is None else float(slow_request_ms) / 1e3
+        )
         self._q: "queue.SimpleQueue" = queue.SimpleQueue()
         self._closed = threading.Event()
         # one lock guards the admission state: pending count + service EWMA
@@ -135,14 +178,22 @@ class MicroBatcher:
 
     # -- client side ---------------------------------------------------------
 
-    def submit(self, request: ScoreRequest, deadline_s: Optional[float] = None) -> Future:
+    def submit(
+        self,
+        request: ScoreRequest,
+        deadline_s: Optional[float] = None,
+        trace: Optional[RequestTrace] = None,
+    ) -> Future:
         """Enqueue one request; the Future resolves to its float64 score.
 
         ``deadline_s`` is the request's latency budget in seconds from now.
         A request that the admission controller predicts cannot meet its
         budget (or that meets a full queue) raises :class:`ShedError`
         immediately; one whose deadline expires while queued gets the same
-        error through its Future."""
+        error through its Future. ``trace`` (socket front) threads the
+        request's trace_id through every stage: the admission decision,
+        the queue wait, and the scored batch each land as a span parented
+        under the request."""
         if self._closed.is_set():
             raise RuntimeError("MicroBatcher is closed")
         # photon: ignore[R7] — cross-thread enqueue stamp: the matching read
@@ -170,6 +221,14 @@ class MicroBatcher:
                 self._pending += 1
         reg = obs.current_run().registry
         reg.counter("photon_serving_offered_total", _OFFERED_HELP).inc()
+        # photon: ignore[R7] — closes the admission-stage interval opened by
+        # the enqueue stamp; lands on the span timeline via record_span (the
+        # decision spans the lock, so no context manager can bracket it)
+        admitted = time.perf_counter()
+        _stage_span(
+            trace, "serving.admit", now, admitted,
+            outcome=reason or "admitted",
+        )
         if reason is not None:
             reg.counter("photon_serving_shed_total", _SHED_HELP).labels(
                 reason=reason
@@ -177,7 +236,7 @@ class MicroBatcher:
             self._publish_queue_gauges(reg)
             raise ShedError(reason, msg)
         fut: Future = Future()
-        self._q.put((request, now, deadline, fut))
+        self._q.put((request, now, deadline, fut, trace))
         self._publish_queue_gauges(reg)
         return fut
 
@@ -221,13 +280,16 @@ class MicroBatcher:
             now = time.perf_counter()
             live, expired = [], []
             for item in batch:
-                _, t0, deadline, _ = item
+                _, t0, deadline, _, _ = item
                 (expired if deadline is not None and now > deadline else live).append(item)
             if expired:
                 reg.counter("photon_serving_shed_total", _SHED_HELP).labels(
                     reason="expired"
                 ).inc(len(expired))
-                for _, t0, _, fut in expired:
+                for _, t0, _, fut, trace in expired:
+                    _stage_span(
+                        trace, "serving.batch", t0, now, outcome="expired"
+                    )
                     fut.set_exception(
                         ShedError(
                             "expired",
@@ -258,7 +320,10 @@ class MicroBatcher:
                     "requests failed inside the score engine",
                 )
                 errors.inc(len(live))
-                for _, _, _, fut in live:
+                for _, t0, _, fut, trace in live:
+                    _stage_span(
+                        trace, "serving.batch", t0, now, outcome="error"
+                    )
                     fut.set_exception(exc)
                 self._dec_pending(len(live))
                 self._publish_queue_gauges(reg)
@@ -279,9 +344,38 @@ class MicroBatcher:
                 "request latency, enqueue to scored",
                 buckets=SERVING_LATENCY_BUCKETS,
             )
-            for i, (_, t0, _, fut) in enumerate(live):
+            n_slow = 0
+            for i, (_, t0, _, fut, trace) in enumerate(live):
                 fut.set_result(float(scores[i]))
-                lat.observe(done - t0)
+                total_s = done - t0
+                lat.observe(total_s)
+                # per-stage spans for traced requests: queue wait + batch
+                # formation (enqueue -> engine start), then the scored batch
+                _stage_span(
+                    trace, "serving.batch", t0, t_score, outcome="scored"
+                )
+                _stage_span(
+                    trace, "serving.score", t_score, done, batch_size=len(live)
+                )
+                if (
+                    self.slow_request_s is not None
+                    and total_s > self.slow_request_s
+                ):
+                    n_slow += 1
+                    logger.warning(
+                        "slow request%s: %.1fms total "
+                        "(queue+batch %.1fms, score %.1fms, batch=%d)",
+                        f" trace_id={trace.trace_id}" if trace else "",
+                        total_s * 1e3,
+                        (t_score - t0) * 1e3,
+                        (done - t_score) * 1e3,
+                        len(live),
+                    )
+            if n_slow:
+                reg.counter(
+                    "photon_serving_slow_requests_total",
+                    "completed requests slower than the slow-request threshold",
+                ).inc(n_slow)
             self._dec_pending(len(live))
             reg.counter(
                 "photon_serving_requests_total", "requests scored"

@@ -158,7 +158,11 @@ class RefreshWatcher:
                 # model, surface the failure in metrics, retry next poll
                 obs.swallowed_error("serving.refresh")
                 return
-            self._on_flip(name, store)
+            # the flip lands on the span timeline (and therefore in the
+            # flight recorder's ring): a latency anomaly that coincides
+            # with a snapshot flip is diagnosable from the postmortem alone
+            with obs.span("serving.refresh.flip", snapshot=name):
+                self._on_flip(name, store)
             self._live = name
             obs.current_run().registry.counter(
                 "photon_serving_refresh_total",

@@ -20,12 +20,20 @@ connection-handler::
 
     -> {"features": {"shard": [[idx...], [val...]]}, "ids": {...},
         "offset": 0.0, "deadline_ms": 50}
-    <- {"score": 1.25}
-     | {"error": "...", "error_type": "shed", "reason": "deadline"}
-     | {"error": "...", "error_type": "bad_request", "kind": "not_json"}
-     | {"error": "...", "error_type": "error"}
+    <- {"score": 1.25, "trace_id": "..."}
+     | {"error": "...", "error_type": "shed", "reason": "deadline",
+        "trace_id": "..."}
+     | {"error": "...", "error_type": "bad_request", "kind": "not_json",
+        "trace_id": "..."}
+     | {"error": "...", "error_type": "error", "trace_id": "..."}
 
 one connection per client, one request per line, responses in order.
+Every response carries a ``trace_id`` — success, shed and bad_request
+alike — assigned per connection at accept time (or echoed back when the
+client sent its own ``"trace_id"`` field); the same id threads through the
+batcher's per-stage spans (``serving.admit``/``serving.batch``/
+``serving.score``, parented under the request's ``serving.request`` span),
+so one slow response is greppable end to end across the trace timeline.
 Malformed input never kills the connection silently: oversized lines,
 non-JSON, and bad fields each get a typed error (and a
 ``photon_serving_bad_request_total{kind=}`` count); mid-line disconnects are
@@ -36,6 +44,7 @@ outlives the listener holding an open socket.
 
 from __future__ import annotations
 
+import itertools
 import json
 import numbers
 import os
@@ -46,7 +55,7 @@ from typing import Optional, Tuple, Union
 import jax.numpy as jnp
 
 from .. import obs
-from .batcher import MicroBatcher, ShedError
+from .batcher import MicroBatcher, RequestTrace, ShedError
 from .engine import ScoreEngine, ScoreRequest
 from .refresh import RefreshWatcher, open_current
 from .store import ModelStore
@@ -76,6 +85,7 @@ class ScoringServer:
         poll_seconds: float = 0.2,
         dtype=jnp.float32,
         status_port: Optional[int] = None,
+        slow_request_ms: Optional[float] = None,
     ):
         if sum(x is not None for x in (store, engine, serving_root)) != 1:
             raise ValueError("pass exactly one of store / engine / serving_root")
@@ -103,6 +113,7 @@ class ScoringServer:
             max_batch=max_batch,
             max_latency_ms=max_latency_ms,
             max_pending=max_pending,
+            slow_request_ms=slow_request_ms,
         )
         if overload_shed_threshold is not None:
             # /healthz compares the scrape-delta shed rate against this
@@ -166,23 +177,33 @@ class ScoringServer:
 
     # -- scoring surface ------------------------------------------------------
 
-    def submit(self, request: ScoreRequest, deadline_s: Optional[float] = None):
+    def submit(
+        self,
+        request: ScoreRequest,
+        deadline_s: Optional[float] = None,
+        trace: Optional[RequestTrace] = None,
+    ):
         """Enqueue one request; returns a Future resolving to its score.
         ``deadline_s`` overrides the server's ``default_deadline_ms`` budget
         for this request (None = use the server default; the admission
-        controller may raise :class:`ShedError` immediately)."""
+        controller may raise :class:`ShedError` immediately). ``trace``
+        threads a request-scoped trace context (trace_id + root span)
+        through the batcher's per-stage spans."""
         if deadline_s is None:
             deadline_s = self.default_deadline_s
-        return self._batcher.submit(request, deadline_s=deadline_s)
+        return self._batcher.submit(request, deadline_s=deadline_s, trace=trace)
 
     def score(
         self,
         request: ScoreRequest,
         timeout: float = 30.0,
         deadline_s: Optional[float] = None,
+        trace: Optional[RequestTrace] = None,
     ) -> float:
         """Blocking single-request score (sheds surface as ShedError)."""
-        return self.submit(request, deadline_s=deadline_s).result(timeout=timeout)
+        return self.submit(request, deadline_s=deadline_s, trace=trace).result(
+            timeout=timeout
+        )
 
     def queue_stats(self) -> dict:
         """Live admission-queue stats (pending depth + drain estimate)."""
@@ -273,10 +294,20 @@ def _parse_score_request(msg) -> Tuple[ScoreRequest, Optional[float]]:
     return ScoreRequest(features=parsed, ids=ids, offset=float(offset)), deadline_s
 
 
+# connection sequence for trace_id assignment: ids are unique per process
+# (pid prefix) and per accepted connection, so a fleet-merged trace stream
+# never collides request ids across replicas
+_conn_ids = itertools.count(1)
+
+
 def _handle_conn(server: ScoringServer, conn: socket.socket, conns, conns_lock) -> None:
     """One JSON-lines connection: the shared handler behind both the AF_UNIX
     and the TCP listener. Registered in ``conns`` so the listener can shut
-    the connection down deterministically at stop time."""
+    the connection down deterministically at stop time. Every request gets
+    a ``trace_id`` (``<pid>-<conn>.<seq>``, or the client's own) echoed on
+    every response shape."""
+    conn_id = f"{os.getpid():x}-{next(_conn_ids)}"
+    req_seq = itertools.count(1)
     try:
         with conn, conn.makefile("rwb") as f:
 
@@ -295,6 +326,7 @@ def _handle_conn(server: ScoringServer, conn: socket.socket, conns, conns_lock) 
                     break  # shutdown() from the stop path, or peer reset
                 if not line:
                     break  # clean EOF
+                trace_id = f"{conn_id}.{next(req_seq)}"
                 if len(line) > MAX_REQUEST_LINE_BYTES:
                     # framing is unrecoverable past the cap: typed refusal,
                     # then a deterministic close
@@ -307,6 +339,7 @@ def _handle_conn(server: ScoringServer, conn: socket.socket, conns, conns_lock) 
                             ),
                             "error_type": "bad_request",
                             "kind": "oversized",
+                            "trace_id": trace_id,
                         }
                     )
                     break
@@ -326,36 +359,56 @@ def _handle_conn(server: ScoringServer, conn: socket.socket, conns, conns_lock) 
                             "error": f"request is not valid JSON: {exc}",
                             "error_type": "bad_request",
                             "kind": "not_json",
+                            "trace_id": trace_id,
                         }
                     ):
                         break
                     continue
-                try:
-                    req, deadline_s = _parse_score_request(msg)
-                except BadRequestError as exc:
-                    _count_bad_request(exc.kind)
-                    if not respond(
-                        {
+                if isinstance(msg, dict) and msg.get("trace_id") is not None:
+                    # client-supplied correlation id: echoed and threaded
+                    # through the stage spans in place of the assigned one
+                    trace_id = str(msg["trace_id"])
+                with obs.span("serving.request", trace_id=trace_id) as root:
+                    try:
+                        req, deadline_s = _parse_score_request(msg)
+                    except BadRequestError as exc:
+                        _count_bad_request(exc.kind)
+                        root.attrs["outcome"] = "bad_request"
+                        out = {
                             "error": str(exc),
                             "error_type": "bad_request",
                             "kind": exc.kind,
+                            "trace_id": trace_id,
                         }
-                    ):
-                        break
-                    continue
-                try:
-                    out = {"score": server.score(req, deadline_s=deadline_s)}
-                except ShedError as exc:
-                    # admission refusal: a typed response, never a dropped
-                    # connection — the client can back off and retry
-                    out = {
-                        "error": str(exc),
-                        "error_type": "shed",
-                        "reason": exc.reason,
-                    }
-                except Exception as exc:
-                    obs.swallowed_error("serving.socket")
-                    out = {"error": str(exc), "error_type": "error"}
+                    else:
+                        trace = RequestTrace(trace_id=trace_id, parent=root)
+                        try:
+                            out = {
+                                "score": server.score(
+                                    req, deadline_s=deadline_s, trace=trace
+                                ),
+                                "trace_id": trace_id,
+                            }
+                            root.attrs["outcome"] = "ok"
+                        except ShedError as exc:
+                            # admission refusal: a typed response, never a
+                            # dropped connection — the client can back off
+                            # and retry
+                            root.attrs["outcome"] = "shed"
+                            out = {
+                                "error": str(exc),
+                                "error_type": "shed",
+                                "reason": exc.reason,
+                                "trace_id": trace_id,
+                            }
+                        except Exception as exc:
+                            obs.swallowed_error("serving.socket")
+                            root.attrs["outcome"] = "error"
+                            out = {
+                                "error": str(exc),
+                                "error_type": "error",
+                                "trace_id": trace_id,
+                            }
                 if not respond(out):
                     break
     finally:

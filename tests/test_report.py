@@ -270,12 +270,15 @@ def test_report_json_golden_schema(tmp_path):
     root = _make_artifacts(str(tmp_path / "artifacts"))
     doc = report_cli.run([root, "--out", str(tmp_path / "rep")])
 
-    assert doc["schema_version"] == 2
+    assert doc["schema_version"] == 3
     assert set(doc) == {
         "schema_version", "task", "best", "models", "convergence",
-        "performance", "plan", "memory", "checkpoints", "bench",
+        "performance", "plan", "memory", "checkpoints", "bench", "flight",
     }
     assert doc["task"] == "logistic_regression"
+
+    # v3: flight-recorder postmortems ride along (none in these artifacts)
+    assert doc["flight"] == []
 
     # v2: the resolved execution plan rides along verbatim from
     # run_summary.json (None when the run predates the planner)
@@ -349,6 +352,34 @@ def test_report_json_golden_schema(tmp_path):
     with open(os.path.join(out, "report.html")) as f:
         html = f.read()
     assert html.lower().startswith("<!doctype html>") and "<svg" in html
+
+
+def test_report_discovers_flight_dumps(tmp_path):
+    """A flight-recorder postmortem in the artifacts tree lands as a
+    doc["flight"] row (and an HTML section), ordered by trigger time."""
+    root = _make_artifacts(str(tmp_path / "artifacts"))
+    flight_dir = os.path.join(root, "flight")
+    os.makedirs(flight_dir, exist_ok=True)
+    for seq, (kind, t) in enumerate(
+        [("shed_spike", 200.0), ("crash", 100.0)]
+    ):
+        with open(
+            os.path.join(flight_dir, f"flight-{kind}-{seq:04d}.json"), "w"
+        ) as f:
+            json.dump({
+                "trigger": {"kind": kind, "detail": "drill", "unix_time": t},
+                "window_seconds": 30.0,
+                "identity": {"process_index": 0, "replica": None, "host": "h"},
+                "events": [{"type": "span", "name": "x"}],
+                "metrics": [],
+            }, f)
+    doc = report_cli.run([root, "--out", str(tmp_path / "rep")])
+    assert [row["trigger"] for row in doc["flight"]] == ["crash", "shed_spike"]
+    row = doc["flight"][0]
+    assert row["detail"] == "drill" and row["n_events"] == 1
+    assert row["path"].startswith("flight/")
+    html = open(os.path.join(str(tmp_path / "rep"), "report.html")).read()
+    assert "Flight recorder" in html
 
 
 def test_report_cli_rejects_empty_dir(tmp_path):

@@ -458,14 +458,180 @@ def test_socket_server_roundtrip(tmp_path, run_telemetry):
             f.flush()
             resp = json.loads(f.readline())
             assert abs(resp["score"] - oracle_score(model, req)) < 1e-12
+            # every response carries the request-scoped trace id
+            assert resp["trace_id"]
             # malformed request -> error response, connection stays up
             f.write(b'{"features": "nonsense"}\n')
             f.flush()
             resp2 = json.loads(f.readline())
             assert "error" in resp2
+            assert resp2["trace_id"]
+            assert resp2["trace_id"] != resp["trace_id"]
+            # client-supplied trace_id is echoed, not replaced
+            payload["trace_id"] = "client-abc-123"
+            f.write((json.dumps(payload) + "\n").encode())
+            f.flush()
+            resp3 = json.loads(f.readline())
+            assert resp3["trace_id"] == "client-abc-123"
+            assert abs(resp3["score"] - oracle_score(model, req)) < 1e-12
     finally:
         stop.set()
         t.join(timeout=10)
+        server.close()
+
+
+def test_shed_response_carries_trace_id(tmp_path, run_telemetry):
+    """Sheds are responses too: the AF_UNIX front echoes the trace_id on a
+    shed so the client can tie the refusal back to its request."""
+    model = make_model()
+    store_dir = serving.build_store_from_model(model, str(tmp_path / "store"))
+    server = serving.ScoringServer(
+        store=serving.ModelStore.open(store_dir),
+        max_latency_ms=1.0,
+        dtype=jnp.float64,
+        default_deadline_ms=1e-6,  # expires before admission: always sheds
+    )
+    sock_path = str(tmp_path / "serve.sock")
+    stop = threading.Event()
+    t = threading.Thread(
+        target=serving.serve_socket, args=(server, sock_path, stop), daemon=True
+    )
+    t.start()
+    try:
+        deadline = time.time() + 10
+        while not os.path.exists(sock_path) and time.time() < deadline:
+            time.sleep(0.01)
+        rng = np.random.default_rng(11)
+        req = make_request(rng, "uA")
+        payload = {
+            "features": {k: [list(v[0]), list(v[1])] for k, v in req.features.items()},
+            "trace_id": "shed-trace-9",
+        }
+        with socket.socket(socket.AF_UNIX, socket.SOCK_STREAM) as c:
+            c.connect(sock_path)
+            f = c.makefile("rwb")
+            f.write((json.dumps(payload) + "\n").encode())
+            f.flush()
+            resp = json.loads(f.readline())
+        assert resp["error_type"] == "shed"
+        assert resp["trace_id"] == "shed-trace-9"
+        assert resp["reason"]
+    finally:
+        stop.set()
+        t.join(timeout=10)
+        server.close()
+    sheds = [
+        m for m in run_telemetry.registry.snapshot()
+        if m["name"] == "photon_serving_shed_total"
+    ]
+    assert sheds and sum(m["value"] for m in sheds) >= 1
+
+
+def test_request_stage_spans_and_slow_counter(tmp_path, run_telemetry):
+    """A traced request lands per-stage spans (admit/batch/score) parented
+    under its serving.request root and all stamped with the trace_id; with a
+    sub-millisecond slow threshold every request also trips the
+    slow-request counter."""
+    from photon_ml_tpu.serving.batcher import RequestTrace
+
+    spans = []
+
+    class _SpanTap:
+        def handle(self, event):
+            if isinstance(event, obs.SpanEvent):
+                spans.append(event.span)
+
+    run_telemetry.register_listener(_SpanTap())
+    model = make_model()
+    store_dir = serving.build_store_from_model(model, str(tmp_path / "store"))
+    server = serving.ScoringServer(
+        store=serving.ModelStore.open(store_dir),
+        max_latency_ms=1.0,
+        dtype=jnp.float64,
+        slow_request_ms=1e-4,  # everything is "slow": the counter must fire
+    )
+    try:
+        rng = np.random.default_rng(13)
+        req = make_request(rng, "uB")
+        with obs.span("serving.request", trace_id="t-42") as root:
+            trace = RequestTrace(trace_id="t-42", parent=root)
+            score = server.score(req, trace=trace)
+        assert abs(score - oracle_score(model, req)) < 1e-12
+    finally:
+        server.close()
+
+    by_name = {s.name: s for s in spans}
+    for stage in ("serving.admit", "serving.batch", "serving.score"):
+        assert stage in by_name, f"missing stage span {stage}"
+        assert by_name[stage].parent_id == root.span_id
+        assert by_name[stage].attrs["trace_id"] == "t-42"
+    assert by_name["serving.request"].attrs["trace_id"] == "t-42"
+    slow = [
+        m for m in run_telemetry.registry.snapshot()
+        if m["name"] == "photon_serving_slow_requests_total"
+    ]
+    assert slow and slow[0]["value"] >= 1
+
+
+def test_shed_storm_one_flight_dump_zero_requests_lost(tmp_path, run_telemetry):
+    """The acceptance drill: a shed storm triggers exactly ONE flight-recorder
+    dump (the cooldown latch holds for the rest of the storm) and every
+    submitted request still resolves — scored or cleanly shed, none lost."""
+    rec = obs.FlightRecorder(
+        str(tmp_path / "flight"),
+        run=run_telemetry,
+        shed_rate_threshold=5.0,
+        poll_interval_s=0.0,
+        cooldown_s=60.0,
+    )
+    run_telemetry.register_listener(rec)
+    model = make_model()
+    store_dir = serving.build_store_from_model(model, str(tmp_path / "store"))
+    server = serving.ScoringServer(
+        store=serving.ModelStore.open(store_dir),
+        max_latency_ms=20.0,
+        dtype=jnp.float64,
+        max_pending=2,  # tiny queue: the flood sheds on queue_full
+    )
+    try:
+        rng = np.random.default_rng(17)
+        # warm-up request establishes the recorder's rate baseline
+        server.score(make_request(rng, "uA"))
+        assert rec.poll(force=True) is None
+        time.sleep(0.05)
+
+        futs = []
+        for i in range(200):
+            try:
+                futs.append(server.submit(make_request(rng, "uC")))
+            except serving.ShedError:
+                futs.append(None)  # shed at admission: still a clean outcome
+        scored = shed = 0
+        for fut in futs:
+            if fut is None:
+                shed += 1
+                continue
+            try:
+                fut.result(timeout=30.0)
+                scored += 1
+            except serving.ShedError:
+                shed += 1
+        assert scored + shed == 200  # zero requests lost
+        assert shed >= 1  # the storm actually shed
+
+        first = rec.poll(force=True)
+        assert first is not None
+        # storm keeps raging; the latch holds — still exactly one dump
+        for _ in range(5):
+            try:
+                server.submit(make_request(rng, "uC")).result(timeout=30.0)
+            except serving.ShedError:
+                pass
+            assert rec.poll(force=True) is None
+        assert len(rec.dump_paths) == 1
+        doc = json.load(open(first))
+        assert doc["trigger"]["kind"] == "shed_spike"
+    finally:
         server.close()
 
 
@@ -488,6 +654,7 @@ def test_cli_serve_store_dir_socket(tmp_path):
                 "--socket", sock_path,
                 "--max-latency-ms", "1.0",
                 "--metrics-out", metrics_dir,
+                "--replica-id", "r3",
             ],
             stop,
         ),
@@ -507,6 +674,7 @@ def test_cli_serve_store_dir_socket(tmp_path):
             resp = json.loads(f.readline())
         w = np.asarray(model.models["global"].model.coefficients.means)
         assert abs(resp["score"] - float(w[0])) < 1e-6
+        assert resp["trace_id"]
     finally:
         stop.set()
         t.join(timeout=30)
@@ -516,6 +684,11 @@ def test_cli_serve_store_dir_socket(tmp_path):
     text = open(prom).read()
     assert "photon_serving_request_latency_seconds_p99" in text
     assert "photon_serving_requests_total" in text
+    # --replica-id stamps the obs identity into the build-info gauge
+    assert "photon_build_info{" in text
+    assert 'replica="r3"' in text
+    # the serve driver arms a flight recorder beside the metric sinks
+    assert os.path.isdir(os.path.join(metrics_dir, "flight"))
 
 
 # -- prometheus quantiles ----------------------------------------------------
