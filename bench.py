@@ -2143,6 +2143,65 @@ def run_diff_files(
     return rc
 
 
+def bench_lint():
+    """Cold-vs-cached timing of the full static-analysis run (R1-R16).
+
+    Pure host: the lint engine is stdlib-only, so this config must never
+    initialize JAX or the compile cache. The cache directory is a fresh
+    temp dir (never the repo's own ``.photon-lint-cache/``), so "cold"
+    really is an empty cache and the repo's working cache is untouched.
+    """
+    import shutil
+    import tempfile
+
+    from photon_ml_tpu.analysis import engine
+    from photon_ml_tpu.analysis.config import load_config
+
+    config = load_config()  # the repo's pyproject config, as the CLI runs it
+    tmp = tempfile.mkdtemp(prefix="photon-lint-bench-")
+    saved = engine.CACHE_DIR_NAME
+    # CACHE_DIR_NAME is joined under the config root; an absolute path wins
+    # the join, which is how tests point the cache elsewhere too
+    engine.CACHE_DIR_NAME = tmp
+    try:
+        t0 = time.perf_counter()
+        cold = engine.analyze_paths(config=config, cache=True)
+        cold_sec = time.perf_counter() - t0
+        t0 = time.perf_counter()
+        warm = engine.analyze_paths(config=config, cache=True)
+        cached_sec = time.perf_counter() - t0
+    finally:
+        engine.CACHE_DIR_NAME = saved
+        shutil.rmtree(tmp, ignore_errors=True)
+    assert (
+        [f.to_dict() for f in warm.findings]
+        == [f.to_dict() for f in cold.findings]
+        and warm.parse_errors == cold.parse_errors
+        and warm.config_errors == cold.config_errors
+    ), "cached lint diverged from cold"
+    speedup = cold_sec / cached_sec if cached_sec > 0 else float("inf")
+    series = {"cold_sec": round(cold_sec, 4), "cached_sec": round(cached_sec, 4)}
+    # direction self-check: both series must diff as lower-is-better (a
+    # seconds series gating higher-is-better would wave slowdowns through)
+    for name in series:
+        assert _lower_is_better(name), (
+            f"--diff direction check: lint series {name!r} must be "
+            "lower-is-better"
+        )
+    return {
+        "metric": "lint_cached_sec",
+        "value": series["cached_sec"],
+        "unit": (
+            f"seconds, cached re-lint of the full package (R1-R16, "
+            f"{len(cold.active)} active findings) against a run-level "
+            f"cache hit; cold first run {series['cold_sec']:.2f}s, "
+            f"{speedup:.1f}x speedup"
+        ),
+        "vs_baseline": round(speedup, 2),
+        "quadrants": {"lint": series},
+    }
+
+
 def main(argv: Optional[List[str]] = None):
     import argparse
 
@@ -2152,7 +2211,7 @@ def main(argv: Optional[List[str]] = None):
         choices=[
             "glmix", "sparse", "billion", "tiled", "hbm", "streamed-fe",
             "serving", "serving-openloop", "multichip", "ingest", "sweep",
-            "retrain", "scale",
+            "retrain", "scale", "lint",
         ],
         default="glmix",
     )
@@ -2232,6 +2291,11 @@ def main(argv: Optional[List[str]] = None):
                 tolerance=a.tolerance, progress_out=a.progress_out,
             )
         )
+
+    if a.config == "lint":
+        # pure-host path: the lint engine is stdlib-only, keep JAX out
+        print(json.dumps(bench_lint()))
+        return
 
     from photon_ml_tpu.utils.compile_cache import (
         enable_persistent_compilation_cache,

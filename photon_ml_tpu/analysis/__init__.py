@@ -13,15 +13,22 @@ that discipline, in two halves:
   hazards inside ``@jit``, dtype discipline, swallow-and-continue handlers,
   non-atomic writes, NaN mishandling, unattributed wall-clock timing,
   module-level jax imports on the jax-free report path. Whole-program
-  passes R9-R12 (``analysis/project.py``) — a package-wide symbol table and
-  call graph feeding a thread-context race detector (R9), refusal-ledger
-  consistency against README/tests/``refusals.json`` (R10), the
-  ``photon_*`` metric-name contract (R11), and unused-suppression detection
-  (R12). Run it with ``python -m photon_ml_tpu.analysis``; configure it
-  from ``[tool.photon-lint]`` in pyproject.toml; suppress individual lines
-  with ``# photon: ignore[RULE]``; declare cross-thread intent with
-  ``# photon: guarded-by[lock_attr]`` / ``# photon: thread-confined``;
-  grandfather findings in a checked-in baseline.
+  passes R9-R16 (``analysis/project.py`` + ``analysis/dataflow.py``) — a
+  package-wide symbol table and call graph feeding a thread-context race
+  detector (R9), refusal-ledger consistency against
+  README/tests/``refusals.json`` (R10), the ``photon_*`` metric-name
+  contract (R11), unused-suppression detection (R12), and the
+  interprocedural dataflow rules: lock-order deadlock cycles (R13),
+  resources not released on every CFG path including exception edges
+  (R14), jit tracer hazards by call-graph reachability (R15), and
+  fault-site inventory drift against ``faults.json``/README/tests (R16).
+  Run it with ``python -m photon_ml_tpu.analysis`` (``--cache`` for the
+  incremental mtime+size-keyed fast path); configure it from
+  ``[tool.photon-lint]`` in pyproject.toml; suppress individual lines
+  with ``# photon: ignore[RULE]``; declare intent the analyses cannot see
+  with ``# photon: guarded-by[lock_attr]`` / ``# photon: thread-confined``
+  / ``# photon: lock-order[LockA < LockB]`` / ``# photon:
+  static-arg[name]``; grandfather findings in a checked-in baseline.
 
 - **runtime**: :func:`transfer_guard`, a context manager the CD sweep and
   bench enter, which makes JAX hard-error on any *implicit* device->host
@@ -38,6 +45,7 @@ from .engine import (
     analyze_source,
     load_baseline,
     write_baseline,
+    write_fault_inventory,
     write_refusal_inventory,
 )
 from .project import analyze_project
@@ -61,5 +69,6 @@ __all__ = [
     "logged_fetch",
     "transfer_guard",
     "write_baseline",
+    "write_fault_inventory",
     "write_refusal_inventory",
 ]

@@ -1,6 +1,9 @@
 """``python -m photon_ml_tpu.analysis`` — the lint CLI.
 
-Exit codes: 0 clean, 1 active findings (or parse errors), 2 usage error.
+Exit codes: 0 clean, 1 active findings (or parse/analysis errors), 2 usage
+or configuration error — a bad flag, a bad pyproject key, an unknown
+``thread_entrypoints`` spec, or a malformed annotation grammar (the
+*input* to the linter is wrong, as opposed to the linted code).
 Human output is one ``path:line:col: RULE message`` block per finding;
 ``--json`` emits a machine-readable report for CI annotation.
 """
@@ -17,21 +20,24 @@ from .engine import (
     analyze_paths,
     load_baseline,
     write_baseline,
+    write_fault_inventory,
     write_refusal_inventory,
 )
 from .rules import RULES, explain_rule
 
 # --json report layout version; bump on breaking shape changes
-JSON_SCHEMA_VERSION = 2
+# (v3: adds config_errors; R13-R16 findings appear in findings[])
+JSON_SCHEMA_VERSION = 3
 
 
 def build_parser() -> argparse.ArgumentParser:
     p = argparse.ArgumentParser(
         prog="python -m photon_ml_tpu.analysis",
         description="JAX-aware static analysis: per-file rules R1-R8 plus "
-        "the whole-program passes R9-R12 (thread races, refusal-ledger and "
-        "metric contracts, unused suppressions), configured by "
-        "[tool.photon-lint] in pyproject.toml",
+        "the whole-program passes R9-R16 (thread races, lock-order cycles, "
+        "resource lifecycles, jit tracer hazards, refusal-ledger / "
+        "fault-site / metric contracts, unused suppressions), configured "
+        "by [tool.photon-lint] in pyproject.toml",
     )
     p.add_argument(
         "paths",
@@ -56,6 +62,12 @@ def build_parser() -> argparse.ArgumentParser:
         choices=sorted(RULES),
         help="run only these rules (repeatable)",
     )
+    p.add_argument(
+        "--cache",
+        action="store_true",
+        help="reuse mtime+size-keyed results from .photon-lint-cache/ "
+        "(whole-run and per-file)",
+    )
     p.add_argument("--json", action="store_true", help="JSON report on stdout")
     p.add_argument(
         "--list-rules", action="store_true", help="print the rule table and exit"
@@ -71,6 +83,12 @@ def build_parser() -> argparse.ArgumentParser:
         action="store_true",
         help="regenerate refusals.json from the README ledger and the "
         "package's raise sites, then exit 0",
+    )
+    p.add_argument(
+        "--write-fault-inventory",
+        action="store_true",
+        help="regenerate faults.json from the package's literal "
+        "fault-injection sites, then exit 0",
     )
     return p
 
@@ -95,6 +113,11 @@ def main(argv: Optional[List[str]] = None) -> int:
         print(f"wrote {n} refusal(s) to {path}")
         return 0
 
+    if args.write_fault_inventory:
+        path, n = write_fault_inventory(config)
+        print(f"wrote {n} fault site(s) to {path}")
+        return 0
+
     baseline_path = args.baseline or config.baseline_path
     try:
         baseline = None if args.no_baseline else load_baseline(baseline_path)
@@ -107,6 +130,7 @@ def main(argv: Optional[List[str]] = None) -> int:
         config=config,
         baseline=None if args.write_baseline else baseline,
         rules=args.rule,
+        cache=args.cache,
     )
 
     if args.write_baseline:
@@ -121,6 +145,7 @@ def main(argv: Optional[List[str]] = None) -> int:
                     "schema_version": JSON_SCHEMA_VERSION,
                     "files_scanned": result.files_scanned,
                     "parse_errors": result.parse_errors,
+                    "config_errors": result.config_errors,
                     "findings": [f.to_dict() for f in result.findings],
                     "active": len(result.active),
                     "ok": result.ok,
@@ -138,6 +163,8 @@ def main(argv: Optional[List[str]] = None) -> int:
                 print(f"    {f.code}")
         for err in result.parse_errors:
             print(f"parse error: {err}", file=sys.stderr)
+        for err in result.config_errors:
+            print(f"config error: {err}", file=sys.stderr)
         n_sup = sum(1 for f in result.findings if f.suppressed)
         n_base = sum(1 for f in result.findings if f.baselined)
         print(
@@ -145,6 +172,8 @@ def main(argv: Optional[List[str]] = None) -> int:
             f"({n_sup} suppressed, {n_base} baselined) "
             f"in {result.files_scanned} file(s)"
         )
+    if result.config_errors:
+        return 2
     return 0 if result.ok else 1
 
 

@@ -97,6 +97,12 @@ class LintConfig:
     refusal_tests: str = "tests/test_support_matrix.py"
     # R11: where photon_* series must be documented.
     metric_docs: Tuple[str, ...] = ("README.md",)
+    # R16: the fault-site quadrangle — machine-readable inventory, the README
+    # fault-site table, and the tests/ tree whose string literals must
+    # exercise every site.
+    fault_inventory: str = "faults.json"
+    fault_docs: str = "README.md"
+    fault_tests: str = "tests"
     root: str = "."
 
     def is_hot(self, relpath: str) -> bool:

@@ -26,9 +26,11 @@ from __future__ import annotations
 
 import ast
 import dataclasses
+import hashlib
 import io
 import json
 import os
+import pickle
 import re
 import tokenize
 from collections import Counter
@@ -41,6 +43,17 @@ from .rules import RULES, run_rules
 _SUPPRESS_RE = re.compile(r"#\s*photon:\s*ignore\[([A-Za-z0-9_,\s]+)\]")
 
 BASELINE_VERSION = 1
+
+# incremental-lint cache (the --cache flag). Entries are keyed by content
+# stats (mtime_ns + size per input), so an edit — including to the README
+# ledger, the inventories, or the tests the project passes read — misses.
+CACHE_DIR_NAME = ".photon-lint-cache"
+CACHE_VERSION = 1
+
+# project errors with these prefixes are *configuration* mistakes (bad
+# pyproject entry, malformed annotation grammar) — the CLI exits 2 for
+# them, distinctly from unreadable/unparseable files (exit 1)
+_CONFIG_ERROR_PREFIXES = ("thread_entrypoints:", "annotation:")
 
 
 @dataclasses.dataclass(frozen=True)
@@ -71,6 +84,10 @@ class LintResult:
     findings: List[Finding]
     files_scanned: int
     parse_errors: List[str] = dataclasses.field(default_factory=list)
+    # configuration mistakes (unknown thread_entrypoints spec, malformed
+    # annotation grammar): the user's input is wrong, not the linted code —
+    # reported separately so the CLI can exit 2, like a bad pyproject key
+    config_errors: List[str] = dataclasses.field(default_factory=list)
 
     @property
     def active(self) -> List[Finding]:
@@ -78,7 +95,9 @@ class LintResult:
 
     @property
     def ok(self) -> bool:
-        return not self.active and not self.parse_errors
+        return (
+            not self.active and not self.parse_errors and not self.config_errors
+        )
 
 
 def _suppressions(source: str) -> Dict[int, Set[str]]:
@@ -178,25 +197,137 @@ def iter_python_files(paths: Sequence[str], config: LintConfig) -> List[str]:
     return filtered
 
 
+# --------------------------------------------------------------------------
+# incremental-lint cache
+
+
+def _stat_token(path: str) -> Tuple[str, int, int]:
+    """(path, mtime_ns, size), or zeros when the file is absent — absence is
+    itself a cacheable state (e.g. no baseline checked in yet)."""
+    try:
+        st = os.stat(path)
+        return (path, st.st_mtime_ns, st.st_size)
+    except OSError:
+        return (path, 0, 0)
+
+
+def _aux_input_paths(config: LintConfig) -> List[str]:
+    """Non-linted files whose content the project passes read: docs tables,
+    inventories, and the test tree R10/R16 scan for pins/site literals."""
+    root = os.path.abspath(config.root)
+    out = [
+        os.path.join(root, config.refusal_docs),
+        os.path.join(root, config.refusal_inventory),
+        os.path.join(root, config.refusal_tests),
+        os.path.join(root, config.fault_docs),
+        os.path.join(root, config.fault_inventory),
+    ]
+    out.extend(os.path.join(root, d) for d in config.metric_docs)
+    tests_dir = os.path.join(root, config.fault_tests)
+    if os.path.isdir(tests_dir):
+        for dirpath, dirnames, filenames in os.walk(tests_dir):
+            dirnames[:] = sorted(
+                d for d in dirnames if d != "__pycache__"
+            )
+            out.extend(
+                os.path.join(dirpath, n)
+                for n in sorted(filenames)
+                if n.endswith(".py")
+            )
+    return out
+
+
+def _run_cache_key(
+    files: Sequence[str],
+    config: LintConfig,
+    baseline: Optional[Counter],
+    rules: Optional[Sequence[str]],
+    run_project: bool,
+) -> str:
+    h = hashlib.sha256()
+    h.update(repr((CACHE_VERSION, config, sorted(rules or []), rules is None,
+                   run_project)).encode())
+    if baseline:
+        h.update(repr(sorted(baseline.items())).encode())
+    for path in files:
+        h.update(repr(_stat_token(path)).encode())
+    for path in _aux_input_paths(config):
+        h.update(repr(_stat_token(path)).encode())
+    return h.hexdigest()
+
+
+def _file_cache_key(
+    config: LintConfig, rules: Optional[Sequence[str]], rel: str, path: str
+) -> str:
+    h = hashlib.sha256()
+    h.update(
+        repr(
+            (CACHE_VERSION, config, sorted(rules or []), rules is None, rel)
+        ).encode()
+    )
+    h.update(repr(_stat_token(path)).encode())
+    return h.hexdigest()
+
+
+def _cache_load(cache_dir: str, key: str):
+    try:
+        with open(os.path.join(cache_dir, key + ".pickle"), "rb") as f:
+            payload = pickle.load(f)
+        if payload.get("version") == CACHE_VERSION:
+            return payload["value"]
+    except (OSError, pickle.PickleError, EOFError, ValueError, KeyError,
+            AttributeError, ImportError, IndexError, TypeError):
+        pass  # missing / corrupt / unpicklable: a plain miss
+    return None
+
+
+def _cache_store(cache_dir: str, key: str, value) -> None:
+    try:
+        os.makedirs(cache_dir, exist_ok=True)
+        tmp = os.path.join(cache_dir, f".tmp-{os.getpid()}-{key}")
+        with open(tmp, "wb") as f:
+            pickle.dump({"version": CACHE_VERSION, "value": value}, f)
+        os.replace(tmp, os.path.join(cache_dir, key + ".pickle"))
+    except OSError:
+        pass  # a cache that cannot be written is just a slow cache
+
+
 def analyze_paths(
     paths: Optional[Sequence[str]] = None,
     config: Optional[LintConfig] = None,
     baseline: Optional[Counter] = None,
     rules: Optional[Sequence[str]] = None,
     project: Optional[bool] = None,
+    cache: bool = False,
 ) -> LintResult:
     """Lint files/directories; default paths come from the config.
 
-    The whole-program passes (R9-R11, plus R12's unused-suppression sweep)
-    need the complete package to build an honest call graph, so they run
-    only on full configured-path runs — linting an explicit file subset
-    stays per-file. ``project`` overrides the auto-detection either way.
+    The whole-program passes (R9-R11 and R13-R16, plus R12's
+    unused-suppression sweep) need the complete package to build an honest
+    call graph, so they run only on full configured-path runs — linting an
+    explicit file subset stays per-file. ``project`` overrides the
+    auto-detection either way.
+
+    ``cache=True`` keeps mtime+size-keyed entries under
+    ``.photon-lint-cache/`` in the config root: the whole run's result when
+    nothing changed (the fast path the tier-1 self-check takes), and
+    per-file parse/rule results so an edit re-lints only the touched file
+    before the project passes rerun.
     """
     config = config or LintConfig()
     files = iter_python_files(paths or config.paths, config)
     root = os.path.abspath(config.root)
+    run_project = project if project is not None else paths is None
+    cache_dir = os.path.join(root, CACHE_DIR_NAME)
+    run_key = None
+    if cache:
+        run_key = _run_cache_key(files, config, baseline, rules, run_project)
+        hit = _cache_load(cache_dir, "run-" + run_key)
+        if isinstance(hit, LintResult):
+            return hit
     findings: List[Finding] = []
     errors: List[str] = []
+    config_errors: List[str] = []
     sources: Dict[str, str] = {}
     sup_maps: Dict[str, Dict[int, Set[str]]] = {}
     for path in files:
@@ -204,16 +335,29 @@ def analyze_paths(
         try:
             with open(path, encoding="utf-8") as f:
                 source = f.read()
-            file_findings = analyze_source(source, rel, config, rules=rules)
-        except (SyntaxError, ValueError) as e:
-            errors.append(f"{rel}: {e}")
+        except OSError as e:
+            errors.append(f"cannot read {rel}: {e}")
             continue
+        file_key = _file_cache_key(config, rules, rel, path) if cache else None
+        cached = _cache_load(cache_dir, "file-" + file_key) if cache else None
+        if cached is not None:
+            file_findings, sup = cached
+        else:
+            try:
+                file_findings = analyze_source(source, rel, config, rules=rules)
+                sup = _suppressions(source)
+            except (SyntaxError, ValueError) as e:
+                errors.append(f"{rel}: {e}")
+                continue
+            if cache:
+                _cache_store(
+                    cache_dir, "file-" + file_key, (file_findings, sup)
+                )
         findings.extend(file_findings)
         sources[rel] = source
-        sup_maps[rel] = _suppressions(source)
+        sup_maps[rel] = sup
 
     enabled = set(rules) if rules is not None else set(RULES)
-    run_project = project if project is not None else paths is None
     rules_run = set(enabled)
     if not run_project:
         rules_run -= set(PROJECT_RULE_IDS)
@@ -221,7 +365,11 @@ def analyze_paths(
     used_ann: Set[Tuple[str, int]] = set()
     if run_project and enabled & set(PROJECT_RULE_IDS):
         pres = analyze_project(sources, config, rules=sorted(enabled))
-        errors.extend(pres.errors)
+        for err in pres.errors:
+            if err.startswith(_CONFIG_ERROR_PREFIXES):
+                config_errors.append(err)
+            else:
+                errors.append(err)
         annotations = pres.annotations
         used_ann = pres.used_annotations
         for pf in pres.findings:
@@ -246,9 +394,15 @@ def analyze_paths(
     findings.sort(key=lambda f: (f.file, f.line, f.col, f.rule))
     if baseline:
         findings = apply_baseline(findings, baseline)
-    return LintResult(
-        findings=findings, files_scanned=len(files), parse_errors=errors
+    result = LintResult(
+        findings=findings,
+        files_scanned=len(files),
+        parse_errors=errors,
+        config_errors=config_errors,
     )
+    if cache and run_key is not None:
+        _cache_store(cache_dir, "run-" + run_key, result)
+    return result
 
 
 def _source_line(
@@ -309,32 +463,55 @@ def _unused_suppression_findings(
                         suppressed="R12" in sup_maps[rel].get(line, ()),
                     )
                 )
-    if "R9" in rules_run:
-        for ann in annotations:
-            if (ann.file, ann.line) in used_annotations:
-                continue
-            lines = sources.get(ann.file, "").splitlines()
-            code = (
-                lines[ann.line - 1].strip()
-                if 0 < ann.line <= len(lines)
-                else ""
+    # each annotation kind belongs to one rule; its staleness is judged only
+    # when that rule ran (a --rule R8 pass must not declare them all stale)
+    ann_rule = {
+        "guarded-by": "R9",
+        "thread-confined": "R9",
+        "lock-order": "R13",
+        "static-arg": "R15",
+    }
+    ann_excuse = {
+        "R9": (
+            "the attribute is not shared across thread contexts; delete "
+            "the stale annotation"
+        ),
+        "R13": (
+            "no contrary lock-acquisition edge exists; delete the stale "
+            "annotation"
+        ),
+        "R15": (
+            "the parameter never reaches host control flow in a "
+            "jit-reachable scope; delete the stale annotation"
+        ),
+    }
+    for ann in annotations:
+        rule = ann_rule.get(ann.kind, "R9")
+        if rule not in rules_run:
+            continue
+        if (ann.file, ann.line) in used_annotations:
+            continue
+        lines = sources.get(ann.file, "").splitlines()
+        code = (
+            lines[ann.line - 1].strip()
+            if 0 < ann.line <= len(lines)
+            else ""
+        )
+        out.append(
+            Finding(
+                file=ann.file,
+                line=ann.line,
+                col=0,
+                rule="R12",
+                message=(
+                    f"photon: {ann.kind} annotation suppresses no {rule} "
+                    f"finding — {ann_excuse[rule]}"
+                ),
+                code=code,
+                suppressed="R12"
+                in sup_maps.get(ann.file, {}).get(ann.line, ()),
             )
-            out.append(
-                Finding(
-                    file=ann.file,
-                    line=ann.line,
-                    col=0,
-                    rule="R12",
-                    message=(
-                        f"photon: {ann.kind} annotation suppresses no R9 "
-                        "finding — the attribute is not shared across "
-                        "thread contexts; delete the stale annotation"
-                    ),
-                    code=code,
-                    suppressed="R12"
-                    in sup_maps.get(ann.file, {}).get(ann.line, ()),
-                )
-            )
+        )
     return out
 
 
@@ -420,3 +597,30 @@ def write_refusal_inventory(config: LintConfig) -> Tuple[str, int]:
     with open(out_path, "w", encoding="utf-8") as f:
         f.write(render_refusal_inventory(doc))
     return out_path, len(doc["refusals"])
+
+
+def write_fault_inventory(config: LintConfig) -> Tuple[str, int]:
+    """Regenerate ``faults.json`` from the current tree's literal
+    fault-injection sites (R16's --write-fault-inventory counterpart).
+    Same contract as the refusal inventory: the checked-in file must be
+    byte-identical to a fresh render or the R16 pass fails."""
+    from .dataflow import (
+        build_fault_inventory,
+        extract_fault_sites,
+        render_fault_inventory,
+    )
+
+    root = os.path.abspath(config.root)
+    sources: Dict[str, str] = {}
+    for path in iter_python_files(config.paths, config):
+        rel = os.path.relpath(path, root).replace(os.sep, "/")
+        try:
+            with open(path, encoding="utf-8") as f:
+                sources[rel] = f.read()
+        except OSError:
+            continue
+    doc = build_fault_inventory(extract_fault_sites(sources))
+    out_path = os.path.join(config.root, config.fault_inventory)
+    with open(out_path, "w", encoding="utf-8") as f:
+        f.write(render_fault_inventory(doc))
+    return out_path, len(doc["sites"])

@@ -86,7 +86,8 @@ _SYNC_TYPES = {
 }
 
 _ANNOTATION_RE = re.compile(
-    r"#\s*photon:\s*(?:guarded-by\[([A-Za-z0-9_.]+)\]|(thread-confined))"
+    r"#\s*photon:\s*(?:guarded-by\[([A-Za-z0-9_.]+)\]|(thread-confined)"
+    r"|lock-order\[([^\]]+)\]|static-arg\[([A-Za-z0-9_]+)\])"
 )
 
 _REFUSAL_PHRASES = (
@@ -117,8 +118,10 @@ class Annotation:
 
     file: str
     line: int  # the code line the annotation governs
-    kind: str  # "guarded-by" | "thread-confined"
-    lock: Optional[str]  # guarded-by target, e.g. "_refresh_lock"
+    kind: str  # "guarded-by" | "thread-confined" | "lock-order" | "static-arg"
+    # the bracket payload: the guarded-by lock, the "A < B" lock-order pair,
+    # or the static-arg parameter name (None for thread-confined)
+    lock: Optional[str]
 
 
 @dataclasses.dataclass
@@ -133,6 +136,7 @@ class _Access:
 class _CallSite:
     callee: Tuple[str, str]  # scope key (file, qualname)
     guards: FrozenSet[str]  # lexically held locks at the call
+    line: int = 0  # call site, for R13 lock-order witnesses
 
 
 @dataclasses.dataclass
@@ -146,6 +150,11 @@ class _Scope:
     # callables handed to another thread from this scope: Thread targets,
     # pool submissions, completion callbacks
     spawns: List[Tuple[str, str]] = dataclasses.field(default_factory=list)
+    # lock acquisitions: (lock, locks already held, line) per `with lock:`,
+    # the raw material of R13's lock-order graph
+    acquires: List[Tuple[str, FrozenSet[str], int]] = dataclasses.field(
+        default_factory=list
+    )
 
     @property
     def key(self) -> Tuple[str, str]:
@@ -209,6 +218,7 @@ class ProjectResult:
     annotations: List[Annotation]
     used_annotations: Set[Tuple[str, int]]
     refusal_inventory: Optional[Dict] = None
+    fault_inventory: Optional[Dict] = None
 
 
 # --------------------------------------------------------------------------
@@ -305,9 +315,16 @@ def parse_annotations(source: str, relpath: str) -> List[Annotation]:
                 or lines[target - 1].lstrip().startswith("#")
             ):
                 target += 1
-        kind = "guarded-by" if m.group(1) else "thread-confined"
+        if m.group(1):
+            kind, payload = "guarded-by", m.group(1)
+        elif m.group(2):
+            kind, payload = "thread-confined", None
+        elif m.group(3):
+            kind, payload = "lock-order", m.group(3)
+        else:
+            kind, payload = "static-arg", m.group(4)
         out.append(
-            Annotation(file=relpath, line=target, kind=kind, lock=m.group(1))
+            Annotation(file=relpath, line=target, kind=kind, lock=payload)
         )
     return out
 
@@ -638,6 +655,7 @@ class _BodyWalker:
                     self._walk_expr(item.context_expr, guards)
                     g = self._guard_name(item.context_expr)
                     if g is not None:
+                        self.scope.acquires.append((g, inner, stmt.lineno))
                         inner = inner | {g}
                 self._walk_stmts(stmt.body, inner)
                 continue
@@ -744,7 +762,9 @@ class _BodyWalker:
             elif node.func.attr == "add_done_callback" and node.args:
                 self.scope.spawns.extend(self._callable_ref(node.args[0]))
         for callee in self._callable_ref(node.func):
-            self.scope.calls.append(_CallSite(callee=callee, guards=guards))
+            self.scope.calls.append(
+                _CallSite(callee=callee, guards=guards, line=node.lineno)
+            )
 
 
 def _http_handler_scopes(table: _SymbolTable) -> Set[Tuple[str, str]]:
@@ -885,6 +905,17 @@ def _describe_context(tokens: Set[str]) -> str:
     return "/".join(names)
 
 
+def walk_bodies(table: _SymbolTable) -> None:
+    """Populate every scope's accesses/calls/spawns/acquires. Idempotent:
+    R9, R13 and R15 all need the walked table, in any order, exactly once."""
+    if getattr(table, "_bodies_walked", False):
+        return
+    table._bodies_walked = True
+    for scope in table.scopes.values():
+        mod = table.modules[scope.file]
+        _BodyWalker(table, mod, scope).walk()
+
+
 def run_r9(
     table: _SymbolTable,
     config: LintConfig,
@@ -894,10 +925,7 @@ def run_r9(
     findings: List[ProjectFinding] = []
     used: Set[Tuple[str, int]] = set()
 
-    # walk every scope body
-    for scope in table.scopes.values():
-        mod = table.modules[scope.file]
-        _BodyWalker(table, mod, scope).walk()
+    walk_bodies(table)
 
     worker_roots: Set[Tuple[str, str]] = set()
     for scope in table.scopes.values():
@@ -911,8 +939,11 @@ def run_r9(
     inherited = _inherited_guards(table, worker_roots)
 
     # resolve annotations to shared-variable keys, validating guarded-by
+    # (lock-order / static-arg belong to R13 / R15 — not resolved here)
     ann_by_var: Dict[Tuple, Annotation] = {}
     for ann in annotations:
+        if ann.kind not in ("guarded-by", "thread-confined"):
+            continue
         mod = table.modules.get(ann.file)
         if mod is None:
             continue
@@ -1591,7 +1622,10 @@ def run_r11(
 # entry point
 
 
-PROJECT_RULE_IDS = ("R9", "R10", "R11")
+PROJECT_RULE_IDS = ("R9", "R10", "R11", "R13", "R14", "R15", "R16")
+
+# rules that need the symbol table (and, bar R14, the walked bodies)
+_TABLE_RULES = ("R9", "R13", "R14", "R15")
 
 
 def analyze_project(
@@ -1599,8 +1633,12 @@ def analyze_project(
     config: Optional[LintConfig] = None,
     rules: Optional[Sequence[str]] = None,
 ) -> ProjectResult:
-    """Run the cross-module passes over ``{relpath: source}``. R10/R11 read
-    their docs/tests/inventory counterparts from ``config.root``."""
+    """Run the cross-module passes over ``{relpath: source}``. R10/R11/R16
+    read their docs/tests/inventory counterparts from ``config.root``."""
+    # the dataflow passes import from this module; import lazily to keep the
+    # package import graph acyclic
+    from . import dataflow
+
     config = config or LintConfig()
     enabled = set(rules) if rules is not None else set(PROJECT_RULE_IDS)
     findings: List[ProjectFinding] = []
@@ -1608,12 +1646,17 @@ def analyze_project(
     annotations: List[Annotation] = []
     used: Set[Tuple[str, int]] = set()
     inventory: Optional[Dict] = None
+    fault_inventory: Optional[Dict] = None
 
     for rel in sorted(sources):
         annotations.extend(parse_annotations(sources[rel], rel))
 
-    if "R9" in enabled:
+    table: Optional[_SymbolTable] = None
+    if enabled & set(_TABLE_RULES):
         table = _SymbolTable(sources)
+        walk_bodies(table)
+
+    if "R9" in enabled:
         # record global assignment lines for annotation resolution
         for mod in table.modules.values():
             for node in ast.walk(mod.tree):
@@ -1635,6 +1678,21 @@ def analyze_project(
         findings.extend(r10)
     if "R11" in enabled:
         findings.extend(run_r11(sources, config))
+    if "R13" in enabled:
+        r13, r13_errors, r13_used = dataflow.run_r13(table, annotations)
+        findings.extend(r13)
+        errors.extend(r13_errors)
+        used |= r13_used
+    if "R14" in enabled:
+        findings.extend(dataflow.run_r14(table))
+    if "R15" in enabled:
+        r15, r15_errors, r15_used = dataflow.run_r15(table, annotations)
+        findings.extend(r15)
+        errors.extend(r15_errors)
+        used |= r15_used
+    if "R16" in enabled:
+        r16, fault_inventory = dataflow.run_r16(sources, config)
+        findings.extend(r16)
 
     findings.sort(key=lambda f: (f.file, f.line, f.col, f.rule))
     return ProjectResult(
@@ -1643,4 +1701,5 @@ def analyze_project(
         annotations=annotations,
         used_annotations=used,
         refusal_inventory=inventory,
+        fault_inventory=fault_inventory,
     )

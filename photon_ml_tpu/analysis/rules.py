@@ -82,10 +82,50 @@ R11 metric-contract (whole-program): every literal ``photon_*`` series
    README metrics reference.
 
 R12 unused-suppression: a ``# photon: ignore[RULE]`` that suppresses no
-   finding, or a ``guarded-by``/``thread-confined`` annotation R9 never
-   needed, is itself a finding (mypy's warn-unused-ignores) — stale
-   suppressions silently disable future findings at that site. Only
-   checked for rules that actually ran.
+   finding, or a ``guarded-by``/``thread-confined``/``lock-order``/
+   ``static-arg`` annotation its rule never needed, is itself a finding
+   (mypy's warn-unused-ignores) — stale suppressions silently disable
+   future findings at that site. Only checked for rules that actually ran.
+
+R13 lock-order-deadlock (whole-program; ``analysis/dataflow.py``): every
+   ``with lock:`` acquisition while other locks are held adds a held->
+   acquired edge to a global lock-acquisition graph, and a call made while
+   holding a lock adds edges to every lock the callee may transitively
+   acquire (propagated over the call graph). A cycle means two threads can
+   take the same locks in opposite orders and deadlock. Pin the intended
+   global order with ``# photon: lock-order[LockA < LockB]`` (lock names
+   are ``Class.attr`` for instance locks, the bare name for module-level
+   locks; validated against the known lock set) — the annotation vouches
+   the contrary order is unreachable and deletes that edge.
+
+R14 resource-lifecycle (whole-program): a Thread / WorkerPool / socket /
+   file / mmap / HTTPServer object bound to a local name must be closed,
+   joined, stopped or shut down on *every* control-flow path out of the
+   function — including the paths an exception takes (per-function CFG
+   with exception edges). ``with`` and ``try/finally`` release on all
+   paths; ``daemon=True`` threads are exempt by design; returning the
+   object, storing it on an attribute, or passing it to another call
+   transfers ownership and ends local responsibility (the ``pool=`` idiom
+   in ``io/data.py``).
+
+R15 jit-tracer-hazard (whole-program): reachability from ``@jit`` is
+   computed over the call graph, so helpers a decorated kernel calls are
+   held to tracer discipline too, not just the decorated body (R2 covers
+   that). Inside jit-reachable scopes: a Python ``if``/``while``/
+   short-circuit on a traced value (helpers only), ``float()``/``int()``/
+   ``bool()``/``.item()`` coercions of traced values, and host-side
+   mutation of closed-over state (``global``/``nonlocal``/``self.attr``
+   writes run once at trace time, not per call). Declare a legitimately
+   static operand with ``# photon: static-arg[name]`` on the ``def`` line
+   (validated against the real parameter list).
+
+R16 fault-site-inventory (whole-program): the literal
+   ``faults.check``/``faults.corrupt`` call sites and ``io_call(...,
+   site=...)`` declarations, the checked-in ``faults.json`` inventory, the
+   README fault-site table, and an at-least-one-test-exercises-it scan of
+   ``tests/`` string literals must agree four ways (the R10 refusal-ledger
+   pattern applied to the chaos surface). A stale or missing inventory is
+   a finding; regenerate with ``--write-fault-inventory``.
 
 Taint tracking is deliberately local and conservative: names become
 "jax-typed" through parameter annotations (``Array``, ``jax.Array``, ...)
@@ -116,6 +156,10 @@ RULES: Dict[str, str] = {
     "R10": "refusal ledger drift (code / README / test pins / refusals.json)",
     "R11": "photon_* metric-name contract violation",
     "R12": "unused suppression or annotation",
+    "R13": "lock-order cycle across the call graph (deadlock hazard)",
+    "R14": "resource not released on every path (incl. exception edges)",
+    "R15": "tracer hazard in a @jit-reachable function",
+    "R16": "fault-site inventory drift (code / faults.json / README / tests)",
 }
 
 # attributes whose value is host metadata, not an array: reading them off a
@@ -1132,6 +1176,36 @@ RULE_EXAMPLES: Dict[str, Tuple[str, str]] = {
     "R12": (
         "x = compute()  # photon: ignore[R4] — but nothing fires here",
         "x = compute()  # stale suppression deleted",
+    ),
+    "R13": (
+        "def flip(self):\n    with self._lock:\n        self._store.put(k)   # Store.put takes Store._lock\n"
+        "# elsewhere: Store.drain() holds Store._lock, then calls back into\n"
+        "# a method that takes self._lock — opposite order, deadlock",
+        "# release before calling into the other object:\n"
+        "def flip(self):\n    with self._lock:\n        k = self._key\n    self._store.put(k)\n"
+        "# or pin the one true order (vouches the contrary edge is unreachable):\n"
+        "# photon: lock-order[Scorer._lock < Store._lock]",
+    ),
+    "R14": (
+        "def serve(self):\n    t = threading.Thread(target=self._run)\n    t.start()\n"
+        "    self._warmup()        # raises -> t never joined, thread leaks",
+        "def serve(self):\n    t = threading.Thread(target=self._run)\n    t.start()\n"
+        "    try:\n        self._warmup()\n    finally:\n        self._stop.set()\n        t.join()",
+    ),
+    "R15": (
+        "@jax.jit\ndef step(w, g):\n    return _clip(w - 0.1 * g)\n"
+        "def _clip(x):\n    if x.sum() > 1e3:     # traced value in Python `if`,\n"
+        "        return x / 10.0   # three calls below the jit boundary\n    return x",
+        "def _clip(x):\n    return jnp.where(x.sum() > 1e3, x / 10.0, x)\n"
+        "# or, if the operand really is static per compilation:\n"
+        "def _clip(x, cap):  # photon: static-arg[cap]\n    ...",
+    ),
+    "R16": (
+        'faults.check("solver.step")       # new chaos site...\n'
+        "# ...absent from faults.json, the README fault-site table, and\n"
+        "# every tests/ string literal",
+        "# README fault-site row + a PHOTON_FAULTS test case mention\n"
+        '# "solver.step"; faults.json regenerated with --write-fault-inventory',
     ),
 }
 

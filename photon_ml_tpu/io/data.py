@@ -503,8 +503,10 @@ def _pipeline_parts(
             consume(i, records)
             del records
     finally:
-        stall_counter.labels(mode="chunked").inc(q.budget_stalls)
+        # close first: a metrics-label error must not leave the queue's
+        # worker threads running (budget_stalls stays readable after close)
         q.close()
+        stall_counter.labels(mode="chunked").inc(q.budget_stalls)
 
 
 def scan_index_maps_pipelined(

@@ -306,6 +306,9 @@ def decode_file(
     except ValueError:
         f.close()
         raise ValueError(f"{path}: not an Avro object container file")
+    except BaseException:
+        f.close()  # OSError etc. would otherwise escape with f open
+        raise
     with f:
         try:
             return _decode_mapped(
@@ -509,6 +512,9 @@ def decode_file_chunks(
     except ValueError:
         f.close()
         raise ValueError(f"{path}: not an Avro object container file")
+    except BaseException:
+        f.close()  # OSError etc. would otherwise escape with f open
+        raise
     with f:
         try:
             prep = _prepare_mapped(

@@ -451,21 +451,29 @@ def serve_socket(
             "host:port)"
         )
     stop = stop_event or threading.Event()
+    conns: set = set()
+    conns_lock = threading.Lock()
+    threads = []
     if path is not None:
         if os.path.exists(path):
             os.unlink(path)
         sock = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
-        sock.bind(path)
+        try:
+            sock.bind(path)
+        except BaseException:
+            sock.close()  # a bind error must not leak the fd
+            raise
         bound: object = path
     else:
         host, port = _parse_listen(listen)
         sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
-        sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
-        sock.bind((host, port))
-        bound = sock.getsockname()[:2]
-    conns: set = set()
-    conns_lock = threading.Lock()
-    threads = []
+        try:
+            sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+            sock.bind((host, port))
+            bound = sock.getsockname()[:2]
+        except BaseException:
+            sock.close()  # a bind error must not leak the fd
+            raise
     try:
         with sock:
             sock.listen()

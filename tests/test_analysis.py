@@ -1384,3 +1384,489 @@ def test_guard_env_override_invalid(monkeypatch):
     with pytest.raises(ValueError, match="PHOTON_TRANSFER_GUARD"):
         with transfer_guard():
             pass
+
+
+# ------------------------------------------- R13 (lock-order deadlock)
+
+
+DEADLOCK = {
+    "pkg/locks.py": """
+    import threading
+
+    L1 = threading.Lock()
+    L2 = threading.Lock()
+
+
+    def f():
+        with L1:
+            with L2:
+                pass
+
+
+    def h():
+        with L1:
+            pass
+
+
+    def g():
+        with L2:
+            h()
+    """
+}
+
+
+def test_r13_flags_lock_order_cycle():
+    res = proj(DEADLOCK, rules=("R13",))
+    assert [f.rule for f in res.findings] == ["R13"]
+    msg = res.findings[0].message
+    assert "lock-order cycle" in msg
+    assert "pkg.locks.L1" in msg and "pkg.locks.L2" in msg
+    assert "inside h()" in msg  # the interprocedural witness
+    assert res.errors == []
+
+
+def test_r13_lock_order_annotation_excuses_the_vouched_edge():
+    src = DEADLOCK["pkg/locks.py"].replace(
+        "def f():",
+        "# photon: lock-order[L1 < L2]\n    def f():",
+    )
+    res = proj({"pkg/locks.py": src}, rules=("R13",))
+    assert res.findings == []
+    assert res.errors == []
+    assert res.used_annotations  # consumed, so R12 stays quiet
+
+
+def test_r13_malformed_annotation_is_a_config_error():
+    src = DEADLOCK["pkg/locks.py"].replace(
+        "def f():",
+        "# photon: lock-order[L1 >> L2]\n    def f():",
+    )
+    res = proj({"pkg/locks.py": src}, rules=("R13",))
+    assert any(
+        e.startswith("annotation:") and "malformed" in e for e in res.errors
+    )
+
+
+def test_r13_unknown_lock_name_is_an_error():
+    src = DEADLOCK["pkg/locks.py"].replace(
+        "def f():",
+        "# photon: lock-order[L1 < NOPE]\n    def f():",
+    )
+    res = proj({"pkg/locks.py": src}, rules=("R13",))
+    assert any("unknown lock 'NOPE'" in e and "known:" in e for e in res.errors)
+
+
+def test_r13_consistent_nesting_is_clean():
+    src = DEADLOCK["pkg/locks.py"].replace("with L2:\n            h()", "h()")
+    res = proj({"pkg/locks.py": src}, rules=("R13",))
+    assert res.findings == []
+
+
+# ------------------------------------------- R14 (resource lifecycle)
+
+
+def test_r14_flags_unjoined_thread():
+    res = proj(
+        {
+            "pkg/mod.py": """
+            import threading
+
+
+            def spawn(fn):
+                t = threading.Thread(target=fn)
+                t.start()
+            """
+        },
+        rules=("R14",),
+    )
+    assert [f.rule for f in res.findings] == ["R14"]
+    assert "thread 't'" in res.findings[0].message
+    assert "spawn()" in res.findings[0].message
+
+
+def test_r14_ownership_transfer_is_clean():
+    res = proj(
+        {
+            "pkg/mod.py": """
+            import threading
+
+
+            def spawn(fn):
+                t = threading.Thread(target=fn)
+                t.start()
+                return t
+
+
+            def spawn_into(fn, registry):
+                t = threading.Thread(target=fn)
+                t.start()
+                registry.append(t)
+
+
+            def joined(fn):
+                t = threading.Thread(target=fn)
+                t.start()
+                try:
+                    fn()
+                finally:
+                    t.join()
+
+
+            def daemon(fn):
+                t = threading.Thread(target=fn, daemon=True)
+                t.start()
+            """
+        },
+        rules=("R14",),
+    )
+    assert res.findings == []
+
+
+def test_r14_flags_exception_path_leak():
+    res = proj(
+        {
+            "pkg/mod.py": """
+            import socket
+
+
+            def serve(path, run):
+                sock = socket.socket()
+                sock.bind(path)
+                with sock:
+                    run()
+            """
+        },
+        rules=("R14",),
+    )
+    assert [f.rule for f in res.findings] == ["R14"]
+    assert "exception escapes serve()" in res.findings[0].message
+
+
+def test_r14_catch_all_cleanup_handler_is_clean():
+    res = proj(
+        {
+            "pkg/mod.py": """
+            import socket
+
+
+            def serve(path, run):
+                sock = socket.socket()
+                try:
+                    sock.bind(path)
+                except BaseException:
+                    sock.close()
+                    raise
+                with sock:
+                    run()
+            """
+        },
+        rules=("R14",),
+    )
+    assert res.findings == []
+
+
+# ------------------------------------------- R15 (jit tracer hazards)
+
+
+R15_TP = {
+    "pkg/mod.py": """
+    import jax
+    import jax.numpy as jnp
+
+
+    def helper(x: jax.Array):
+        if x > 0:
+            return jnp.log(x)
+        return x
+
+
+    @jax.jit
+    def f(x: jax.Array):
+        return helper(x)
+    """
+}
+
+
+def test_r15_flags_branch_in_jit_reachable_helper():
+    res = proj(R15_TP, rules=("R15",))
+    assert [f.rule for f in res.findings] == ["R15"]
+    msg = res.findings[0].message
+    assert "Python branch on traced value 'x'" in msg
+    assert "helper()" in msg and "reachable from @jit f()" in msg
+
+
+def test_r15_static_arg_annotation_excuses_the_param():
+    res = proj(
+        {
+            "pkg/mod.py": """
+            import jax
+            import jax.numpy as jnp
+
+
+            def helper(x: jax.Array, n: jax.Array):  # photon: static-arg[n]
+                if n > 3:
+                    return jnp.log(x)
+                return x
+
+
+            @jax.jit
+            def f(x: jax.Array, n):
+                return helper(x, n)
+            """
+        },
+        rules=("R15",),
+    )
+    assert res.findings == []
+    assert res.errors == []
+    assert res.used_annotations
+
+
+def test_r15_flags_float_coercion_and_host_mutation():
+    res = proj(
+        {
+            "pkg/mod.py": """
+            import jax
+
+            count = 0
+
+
+            def helper(x: jax.Array):
+                global count
+                count = count + 1
+                return float(x) * 2.0
+
+
+            @jax.jit
+            def f(x: jax.Array):
+                return helper(x)
+            """
+        },
+        rules=("R15",),
+    )
+    msgs = sorted(f.message for f in res.findings)
+    assert len(msgs) == 2
+    assert any("float() coercion" in m for m in msgs)
+    assert any("write to closed-over 'count'" in m for m in msgs)
+
+
+def test_r15_unreachable_helper_is_clean():
+    src = R15_TP["pkg/mod.py"].replace("@jax.jit\n", "")
+    res = proj({"pkg/mod.py": src}, rules=("R15",))
+    assert res.findings == []
+
+
+def test_r15_static_arg_on_nonparam_is_an_error():
+    res = proj(
+        {
+            "pkg/mod.py": """
+            import jax
+
+
+            def helper(x: jax.Array):  # photon: static-arg[nope]
+                return x
+
+
+            @jax.jit
+            def f(x: jax.Array):
+                return helper(x)
+            """
+        },
+        rules=("R15",),
+    )
+    assert any("matches no parameter" in e for e in res.errors)
+
+
+# ------------------------------------------- R16 (fault-site inventory)
+
+
+FAULTY_MOD = """
+import os
+from photon_ml_tpu.robust import faults, io_call
+
+
+def boundary():
+    faults.check("demo.boundary")
+"""
+
+FAULT_DOCS = """
+# Demo
+
+| Fault site | Injected in | Failure |
+|---|---|---|
+| `demo.boundary` | `pkg/mod.py` | kill at the boundary |
+"""
+
+
+def _r16_repo(tmp_path, docs=FAULT_DOCS, test_literal='"demo.boundary"'):
+    pkg = tmp_path / "pkg"
+    pkg.mkdir()
+    (pkg / "mod.py").write_text(FAULTY_MOD)
+    (tmp_path / "README.md").write_text(docs)
+    tests = tmp_path / "tests"
+    tests.mkdir()
+    (tests / "test_demo.py").write_text(f"SITE = {test_literal}\n")
+    cfg = LintConfig(paths=("pkg",), root=str(tmp_path))
+    return cfg, {"pkg/mod.py": FAULTY_MOD}
+
+
+def test_r16_consistent_repo_is_clean(tmp_path):
+    from photon_ml_tpu.analysis.engine import write_fault_inventory
+
+    cfg, sources = _r16_repo(tmp_path)
+    path, n = write_fault_inventory(cfg)
+    assert n == 1 and os.path.isfile(path)
+    res = analyze_project(sources, cfg, rules=("R16",))
+    assert res.findings == []
+
+
+def test_r16_flags_missing_and_stale_inventory(tmp_path):
+    from photon_ml_tpu.analysis.engine import write_fault_inventory
+
+    cfg, sources = _r16_repo(tmp_path)
+    res = analyze_project(sources, cfg, rules=("R16",))
+    assert any("fault inventory is missing" in f.message for f in res.findings)
+
+    path, _ = write_fault_inventory(cfg)
+    with open(path, "a") as f:
+        f.write("\n")  # byte-compare: even whitespace drift is stale
+    res = analyze_project(sources, cfg, rules=("R16",))
+    assert any("fault inventory is stale" in f.message for f in res.findings)
+
+
+def test_r16_flags_undocumented_and_untested_sites(tmp_path):
+    cfg, sources = _r16_repo(
+        tmp_path, docs="# Demo\n", test_literal='"unrelated"'
+    )
+    msgs = [
+        f.message
+        for f in analyze_project(sources, cfg, rules=("R16",)).findings
+    ]
+    assert any("not documented" in m for m in msgs)
+    assert any("no test exercises fault site" in m for m in msgs)
+
+
+def test_r16_flags_stale_docs_row(tmp_path):
+    cfg, sources = _r16_repo(
+        tmp_path,
+        docs=FAULT_DOCS + "| `demo.renamed` | `pkg/mod.py` | gone |\n",
+    )
+    msgs = [
+        f.message
+        for f in analyze_project(sources, cfg, rules=("R16",)).findings
+    ]
+    assert any(
+        "documented fault site 'demo.renamed' matches no" in m for m in msgs
+    )
+
+
+# ------------------------------------------- incremental cache
+
+
+def test_cache_results_match_and_edits_reparse(tmp_path):
+    cfg = _mini_repo(tmp_path)
+    cold = analyze_paths(config=cfg, cache=True)
+    assert [f.rule for f in cold.active] == ["R4"]
+    assert os.path.isdir(os.path.join(str(tmp_path), ".photon-lint-cache"))
+
+    warm = analyze_paths(config=cfg, cache=True)
+    assert [f.to_dict() for f in warm.findings] == [
+        f.to_dict() for f in cold.findings
+    ]
+
+    # an edit must be re-analyzed, not served from cache
+    (tmp_path / "pkg" / "mod.py").write_text("x = 1\n")
+    edited = analyze_paths(config=cfg, cache=True)
+    assert edited.active == []
+
+    # and an aux-input edit (the fault docs) invalidates the run cache too
+    (tmp_path / "pkg" / "mod.py").write_text(FAULTY_MOD)
+    first = analyze_paths(config=cfg, cache=True)
+    assert any(f.rule == "R16" for f in first.active)
+    (tmp_path / "README.md").write_text(FAULT_DOCS)
+    tests_dir = tmp_path / "tests"
+    tests_dir.mkdir()
+    (tests_dir / "test_demo.py").write_text('SITE = "demo.boundary"\n')
+    from photon_ml_tpu.analysis.engine import write_fault_inventory
+
+    write_fault_inventory(cfg)
+    refreshed = analyze_paths(config=cfg, cache=True)
+    assert refreshed.active == []
+
+
+def test_cache_corrupt_entry_is_a_miss(tmp_path):
+    cfg = _mini_repo(tmp_path)
+    analyze_paths(config=cfg, cache=True)
+    cache_dir = os.path.join(str(tmp_path), ".photon-lint-cache")
+    for name in os.listdir(cache_dir):
+        with open(os.path.join(cache_dir, name), "wb") as f:
+            f.write(b"not a pickle")
+    again = analyze_paths(config=cfg, cache=True)
+    assert [f.rule for f in again.active] == ["R4"]
+
+
+# ------------------------------------------- config-error exit codes
+
+
+def test_cli_unknown_thread_entrypoint_exits_2(tmp_path, capsys):
+    (tmp_path / "pkg").mkdir()
+    (tmp_path / "pkg" / "mod.py").write_text("x = 1\n")
+    (tmp_path / "pyproject.toml").write_text(
+        '[tool.photon-lint]\npaths = ["pkg"]\n'
+        'thread_entrypoints = ["pkg/mod.py::Nope.run"]\n'
+    )
+    assert lint_main(["--config", str(tmp_path / "pyproject.toml")]) == 2
+    err = capsys.readouterr().err
+    assert "config error:" in err
+    assert "thread_entrypoints:" in err
+    assert "does not name a known function" in err
+
+
+def test_cli_malformed_annotation_exits_2(tmp_path, capsys):
+    _mini_repo(
+        tmp_path,
+        source=(
+            "import threading\n\nL1 = threading.Lock()\n\n\n"
+            "# photon: lock-order[L1 >]\ndef f():\n    with L1:\n        pass\n"
+        ),
+    )
+    py = _write_pyproject(tmp_path)
+    assert lint_main(["--config", py]) == 2
+    err = capsys.readouterr().err
+    assert "config error:" in err
+    assert "annotation:" in err and "malformed" in err
+
+
+def test_cli_unreadable_file_exits_1_not_2(tmp_path, capsys):
+    pkg = tmp_path / "pkg"
+    pkg.mkdir()
+    os.symlink(str(tmp_path / "gone.py"), str(pkg / "mod.py"))
+    py = _write_pyproject(tmp_path)
+    assert lint_main(["--config", py]) == 1
+    err = capsys.readouterr().err
+    assert "parse error:" in err and "cannot read" in err
+    assert "config error:" not in err
+
+
+def test_unused_lock_order_and_static_arg_annotations_are_r12(tmp_path):
+    cfg = _mini_repo(
+        tmp_path,
+        textwrap.dedent(
+            """
+            import threading
+
+            L1 = threading.Lock()
+            L2 = threading.Lock()
+
+
+            # photon: lock-order[L1 < L2]
+            def f():
+                with L1:
+                    pass
+            """
+        ),
+    )
+    result = analyze_paths(config=cfg)
+    assert [f.rule for f in result.active] == ["R12"]
+    assert (
+        "lock-order annotation suppresses no R13" in result.active[0].message
+    )

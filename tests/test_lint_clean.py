@@ -72,3 +72,60 @@ def test_refusal_inventory_is_fresh(config, package_sources):
     for entry in res.refusal_inventory["refusals"]:
         assert entry["modules"], f"unenforced refusal: {entry['fragment']!r}"
         assert entry["exceptions"], entry["fragment"]
+
+
+def test_dataflow_rules_are_zero_active(config, package_sources):
+    """R13-R16 hold on the package itself with NO baseline net: no lock-order
+    cycle, no resource leaked on any CFG path, no tracer hazard reachable
+    from a @jit root, no fault-site drift."""
+    res = analyze_project(
+        package_sources, config, rules=("R13", "R14", "R15", "R16")
+    )
+    assert res.errors == []
+    assert res.findings == [], "\n" + "\n".join(
+        f"{f.file}:{f.line}: {f.rule} {f.message}" for f in res.findings
+    )
+
+
+def test_fault_inventory_is_fresh(config, package_sources):
+    """faults.json must be byte-identical to a fresh regeneration (both
+    directions: a new site or a deleted one is equally stale), and every
+    inventoried site must name at least one declaring module."""
+    from photon_ml_tpu.analysis.dataflow import (
+        build_fault_inventory,
+        extract_fault_sites,
+        render_fault_inventory,
+    )
+
+    want = render_fault_inventory(
+        build_fault_inventory(extract_fault_sites(package_sources))
+    )
+    inv_path = os.path.join(config.root, config.fault_inventory)
+    with open(inv_path, encoding="utf-8") as f:
+        assert f.read() == want, "stale: run --write-fault-inventory"
+    doc = build_fault_inventory(extract_fault_sites(package_sources))
+    assert doc["sites"], "expected fault sites in the package"
+    for entry in doc["sites"]:
+        assert entry["modules"], f"siteless entry: {entry['site']!r}"
+
+
+def test_cached_lint_matches_uncached(config, tmp_path, monkeypatch):
+    """--cache is a pure speedup: byte-identical findings, and the second
+    run really is served from the run-level cache entry."""
+    import dataclasses as _dc
+
+    from photon_ml_tpu.analysis.engine import CACHE_DIR_NAME
+
+    cfg = _dc.replace(config, root=config.root)
+    plain = analyze_paths(config=cfg)
+    monkeypatch.setattr(
+        "photon_ml_tpu.analysis.engine.CACHE_DIR_NAME",
+        str(tmp_path / CACHE_DIR_NAME),
+    )
+    cold = analyze_paths(config=cfg, cache=True)
+    warm = analyze_paths(config=cfg, cache=True)
+    for result in (cold, warm):
+        assert [f.to_dict() for f in result.findings] == [
+            f.to_dict() for f in plain.findings
+        ]
+        assert result.files_scanned == plain.files_scanned
