@@ -40,6 +40,8 @@ load the host happens to have. Refresh explicitly with
                                         # size (dryrun_multichip shapes)
   python bench.py --config scale        # 2-process streamed+sharded+pipelined
                                         # GLMix (the planner-unlocked topology)
+  python bench.py --config recovery     # kill-a-worker drill: typed detection
+                                        # wall + resume-to-parity wall
 
 Real training runs report through the telemetry files instead of stdout
 scraping: train with ``cli.train --metrics-out DIR``, then
@@ -1062,6 +1064,260 @@ def bench_scale(n=1536, d_fixed=128, n_users=512, d_re=32, sweeps=2):
                 "p1_peak_rss_bytes": peak_rss[1],
                 "p0_peak_hbm_bytes": peak_hbm[0],
                 "p1_peak_hbm_bytes": peak_hbm[1],
+            }
+        },
+    }
+
+
+_RECOVERY_WORKER = """
+import os
+import sys
+import jax
+jax.config.update("jax_platforms", "cpu")
+try:
+    jax.config.update("jax_num_cpu_devices", 4)
+except AttributeError:
+    pass  # jax 0.4.x: XLA_FLAGS in the env pins the 4 virtual devices
+try:
+    # cross-host collectives on the CPU backend need an explicit impl on
+    # jax versions that don't default it
+    jax.config.update("jax_cpu_collectives_implementation", "gloo")
+except Exception:
+    pass
+jax.config.update("jax_enable_x64", True)
+
+from photon_ml_tpu.cli import train
+
+try:
+    train.run(sys.argv[1:])
+    print("WORKER_OK", jax.process_index())
+    sys.stdout.flush()
+except BaseException as e:  # noqa: BLE001 - drill: report + hard-exit
+    import traceback
+    traceback.print_exc()
+    print("WORKER_DIED %s: %s" % (type(e).__name__, e), file=sys.stderr)
+    sys.stderr.flush()
+    # hard exit: with a dead peer the graceful jax shutdown barrier would
+    # block for its own timeout — the drill wants bounded-time death
+    os._exit(70)
+"""
+
+
+def bench_recovery(n=320, d=6, sweeps=3, collective_timeout=20.0):
+    """Kill-a-worker recovery drill as a measured bench (ISSUE 18 tentpole):
+    a 2-process gang trains with per-sweep two-phase checkpoints; worker 1
+    is killed (``PHOTON_FAULTS=dist.collective:kill:2``) at its second CD
+    sweep barrier; worker 0 must fail with a typed DistributedTimeoutError
+    within the armed collective budget instead of hanging. Both relaunch
+    with ``--resume`` from the last committed checkpoint and must converge
+    to the same model as an uninterrupted reference run.
+
+    value = ``recovery_kill_to_detected_sec`` — wall seconds from the killed
+    worker's process exit to the survivor's typed, nonzero exit (parent-side
+    50ms exit polling; includes the heartbeat-staleness diagnosis and the
+    peer_lost flight dump). Lower is better; the unarmed alternative is an
+    unbounded hang. ``recovery_resume_to_parity_sec`` (the quadrants series)
+    is the full --resume round wall, startup + compile + remaining sweeps
+    included, gated by a 1e-9 coefficient-parity check against the
+    uninterrupted reference."""
+    import socket
+    import subprocess
+    import sys
+    import tempfile
+
+    repo = os.path.dirname(os.path.abspath(__file__))
+    tmp = tempfile.mkdtemp(prefix="bench-recovery-")
+
+    from photon_ml_tpu.io import write_avro_file
+    from photon_ml_tpu.io.schemas import TRAINING_EXAMPLE_AVRO
+
+    rng = np.random.default_rng(7)
+    x = rng.normal(size=(n, d))
+    w = rng.normal(size=d)
+    y = (rng.uniform(size=n) < 1 / (1 + np.exp(-(x @ w)))).astype(int)
+    data_path = os.path.join(tmp, "recovery.avro")
+    write_avro_file(
+        data_path,
+        TRAINING_EXAMPLE_AVRO,
+        [
+            {
+                "label": float(y[i]),
+                "features": [
+                    {"name": f"f{j}", "term": "", "value": float(x[i, j])}
+                    for j in range(d)
+                ],
+            }
+            for i in range(n)
+        ],
+    )
+
+    from photon_ml_tpu.cli import index as index_cli
+
+    index_dir = os.path.join(tmp, "index")
+    common = [
+        "--input-data", data_path,
+        "--feature-shard", "name=global,bags=features",
+    ]
+    index_cli.run(common + ["--output-dir", index_dir])
+
+    def round_args(ckpt, out, metrics_prefix, i, port, extra):
+        return common + [
+            "--task", "logistic_regression",
+            "--coordinate",
+            "name=global,shard=global,optimizer=LBFGS,tolerance=1e-13,"
+            "max.iter=400,reg.type=L2,reg.weights=1",
+            "--coordinate-descent-iterations", str(sweeps),
+            "--feature-index-dir", index_dir,
+            "--checkpoint-dir", ckpt,
+            "--checkpoint-every", "1",
+            "--collective-timeout", str(collective_timeout),
+            "--heartbeat-interval", "0.5",
+            "--heartbeat-timeout", "6",
+            "--metrics-out", os.path.join(tmp, f"{metrics_prefix}-p{i}"),
+            "--output-dir", out,
+            "--mesh-shape", "data=8",
+            "--distributed", f"coordinator=localhost:{port},process={i},n=2",
+            *list(extra),
+        ]
+
+    def run_round(ckpt, out, metrics_prefix, extra=(), env_by_proc=None,
+                  timeout=600):
+        env_base = dict(os.environ, PYTHONPATH=repo)
+        env_base["XLA_FLAGS"] = "--xla_force_host_platform_device_count=4"
+        env_base.pop("PHOTON_FAULTS", None)
+        with socket.socket() as s:
+            s.bind(("localhost", 0))
+            port = s.getsockname()[1]
+        procs = []
+        for i in range(2):
+            env = dict(env_base)
+            env.update((env_by_proc or {}).get(i, {}))
+            procs.append(
+                subprocess.Popen(
+                    [sys.executable, "-c", _RECOVERY_WORKER,
+                     *round_args(ckpt, out, metrics_prefix, i, port, extra)],
+                    env=env, cwd=repo,
+                    stdout=subprocess.PIPE, stderr=subprocess.PIPE,
+                    text=True,
+                )
+            )
+        # 50ms exit polling: the kill->detected interval is the gap between
+        # the two workers' exit timestamps, which communicate() can't see
+        t0 = time.perf_counter()
+        exit_at = [None, None]
+        while any(t is None for t in exit_at):
+            for i, p in enumerate(procs):
+                if exit_at[i] is None and p.poll() is not None:
+                    exit_at[i] = time.perf_counter()
+            if time.perf_counter() - t0 > timeout:
+                for p in procs:
+                    p.kill()
+                raise RuntimeError(
+                    f"recovery bench {metrics_prefix} round timed out — "
+                    "the liveness layer failed to bound the hang"
+                )
+            time.sleep(0.05)
+        outs = [(p.returncode, *p.communicate(timeout=60)) for p in procs]
+        wall = max(exit_at) - t0
+        return outs, exit_at, wall
+
+    ckpt = os.path.join(tmp, "ckpt")
+    out_ref = os.path.join(tmp, "out-ref")
+    out_drill = os.path.join(tmp, "out-drill")
+
+    # uninterrupted reference: the parity target AND the no-fault wall
+    outs, _, reference_wall = run_round(
+        os.path.join(tmp, "ckpt-ref"), out_ref, "ref"
+    )
+    for rc, out_s, err_s in outs:
+        if rc != 0 or "WORKER_OK" not in out_s:
+            raise RuntimeError(
+                f"recovery reference worker failed:\n{out_s}\n{err_s[-2000:]}"
+            )
+
+    # faulted round: p1 dies at its 2nd sweep barrier; p0 must exit typed
+    # and nonzero within the armed budget
+    outs, exit_at, faulted_wall = run_round(
+        ckpt, out_drill, "drill",
+        env_by_proc={1: {"PHOTON_FAULTS": "dist.collective:kill:2"}},
+    )
+    (rc0, _, err0), (rc1, _, err1) = outs
+    if rc1 != 70 or "WORKER_DIED SimulatedKill" not in err1:
+        raise RuntimeError(f"kill did not fire on worker 1:\n{err1[-2000:]}")
+    if rc0 != 70 or "DistributedTimeoutError" not in err0:
+        raise RuntimeError(
+            f"survivor did not fail typed-and-bounded:\n{err0[-2000:]}"
+        )
+    kill_to_detected = exit_at[0] - exit_at[1]
+    assert kill_to_detected > 0, (
+        "survivor exited before the killed worker — the drill measured "
+        "nothing"
+    )
+
+    # recovery: both relaunch --resume from the committed checkpoint
+    outs, _, resume_wall = run_round(
+        ckpt, out_drill, "resume", extra=("--resume",)
+    )
+    for rc, out_s, err_s in outs:
+        if rc != 0 or "WORKER_OK" not in out_s:
+            raise RuntimeError(
+                f"resume worker failed:\n{out_s}\n{err_s[-2000:]}"
+            )
+    if not any("resuming from checkpoint" in err_s for _, _, err_s in outs):
+        raise RuntimeError("resume round did not restore a checkpoint")
+
+    # parity gate: the resumed model must match the uninterrupted reference
+    from photon_ml_tpu.io.index_map import load_partitioned
+    from photon_ml_tpu.io.model_io import load_game_model
+
+    imaps = {"global": load_partitioned(index_dir, "global")}
+
+    def _coef(out_dir):
+        return np.asarray(
+            load_game_model(
+                os.path.join(out_dir, "models", "best"), imaps,
+                task="logistic_regression",
+            ).models["global"].model.coefficients.means
+        )
+
+    drift = float(np.max(np.abs(_coef(out_drill) - _coef(out_ref))))
+    scale_ref = float(np.max(np.abs(_coef(out_ref))))
+    assert drift <= 1e-9 * max(scale_ref, 1.0), (
+        f"resumed model drifted {drift} from the uninterrupted reference"
+    )
+
+    # direction self-check: every recovery series is a wall — lower wins
+    for name in ("kill_to_detected_sec", "resume_to_parity_sec",
+                 "reference_wall_sec", "faulted_wall_sec"):
+        assert _lower_is_better(name), (
+            f"--diff direction check: recovery series {name!r} must be "
+            "lower-is-better"
+        )
+    return {
+        "metric": "recovery_kill_to_detected_sec",
+        "value": round(kill_to_detected, 2),
+        "unit": (
+            "wall seconds from the killed worker's exit (SimulatedKill at "
+            "its 2nd CD sweep barrier) to the survivor's typed "
+            f"DistributedTimeoutError exit, armed collective budget "
+            f"{collective_timeout:.0f}s + 6s heartbeat staleness window "
+            "(unarmed alternative: an unbounded hang in the barrier); "
+            f"2-process gang, n={n} x d={d} logistic FE, {sweeps} CD "
+            "sweeps, per-sweep two-phase checkpoints; resume round "
+            f"restored the committed checkpoint and reached max|drift| "
+            f"{drift:.1e} coefficient parity vs an uninterrupted reference "
+            f"in {resume_wall:.1f}s (startup + compile included)"
+        ),
+        # fraction of the declared budget spent detecting; > 1 would mean
+        # the budget was not honored
+        "vs_baseline": round(kill_to_detected / collective_timeout, 2),
+        "quadrants": {
+            "recovery": {
+                "kill_to_detected_sec": round(kill_to_detected, 2),
+                "resume_to_parity_sec": round(resume_wall, 2),
+                "reference_wall_sec": round(reference_wall, 2),
+                "faulted_wall_sec": round(faulted_wall, 2),
+                "collective_timeout_budget_sec": collective_timeout,
             }
         },
     }
@@ -2211,7 +2467,7 @@ def main(argv: Optional[List[str]] = None):
         choices=[
             "glmix", "sparse", "billion", "tiled", "hbm", "streamed-fe",
             "serving", "serving-openloop", "multichip", "ingest", "sweep",
-            "retrain", "scale", "lint",
+            "retrain", "scale", "lint", "recovery",
         ],
         default="glmix",
     )
@@ -2317,6 +2573,11 @@ def main(argv: Optional[List[str]] = None):
         # the workers are fresh processes with their own backends; the
         # parent only writes data, builds the index and reads summaries
         print(json.dumps(bench_scale()))
+        return
+    if a.config == "recovery":
+        # same subprocess shape as scale: fresh worker backends, the parent
+        # only stages data and watches exit codes / timestamps
+        print(json.dumps(bench_recovery()))
         return
 
     if a.config == "sparse":

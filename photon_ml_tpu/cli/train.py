@@ -40,6 +40,7 @@ from ..io.index_map import IndexMap
 from ..io.model_io import load_game_model
 from ..parallel import multihost
 from ..robust import CheckpointManager, atomic_write, atomic_write_json, faults
+from ..robust import distributed as robust_dist
 from ..ops.normalization import build_normalization
 from ..tuning.rescaling import HyperparameterConfig, ParamRange
 from ..tuning.tuner import get_tuner
@@ -244,6 +245,31 @@ def build_parser() -> argparse.ArgumentParser:
         "for env/cluster auto-detection); each process reads its own row "
         "range and only process 0 writes outputs",
     )
+    p.add_argument(
+        "--collective-timeout",
+        type=float,
+        default=60.0,
+        help="multi-process: budget in seconds for guarded collectives and "
+        "the per-sweep liveness barrier; a dead peer raises a typed "
+        "DistributedTimeoutError within this budget (plus a peer_lost "
+        "flight-recorder dump) instead of hanging forever. 0 disables",
+    )
+    p.add_argument(
+        "--heartbeat-interval",
+        type=float,
+        default=1.0,
+        help="multi-process: seconds between liveness records each process "
+        "writes under <checkpoint-dir|metrics-out>/heartbeats (read back as "
+        "the photon_dist_heartbeat_age_seconds{process=} gauge and to name "
+        "the stale peer in timeout errors). 0 disables the heartbeat plane",
+    )
+    p.add_argument(
+        "--heartbeat-timeout",
+        type=float,
+        default=10.0,
+        help="multi-process: a peer whose newest heartbeat is older than "
+        "this many seconds is reported as presumed lost",
+    )
     p.add_argument("--log-file", default=None)
     p.add_argument("--log-level", default="INFO")
     p.add_argument(
@@ -380,6 +406,24 @@ def run(argv: Optional[List[str]] = None) -> Dict:
             )
         if args.metrics_out and coordinator:
             logger.info("run telemetry -> %s", args.metrics_out)
+    # distributed liveness plane (robust.distributed): heartbeat records in
+    # a shared directory + a process-wide collective budget, so a dead peer
+    # is a bounded-time typed failure instead of a silent hang
+    hb_writer = None
+    if args.distributed and multihost.process_count() > 1:
+        hb_root = args.checkpoint_dir or args.metrics_out
+        hb_dir = os.path.join(hb_root, "heartbeats") if hb_root else None
+        if hb_dir and args.heartbeat_interval > 0:
+            hb_writer = robust_dist.HeartbeatWriter(
+                hb_dir,
+                multihost.process_index(),
+                interval_s=args.heartbeat_interval,
+            ).start()
+        robust_dist.configure_collectives(
+            args.collective_timeout,
+            run_dir=hb_dir,
+            stale_after_s=args.heartbeat_timeout,
+        )
     try:
         summary = _run_training(args, run_t, metric_sinks, t_run0, recorder)
     except BaseException as exc:
@@ -388,9 +432,23 @@ def run(argv: Optional[List[str]] = None) -> Dict:
         # partial timeline / phase attribution collected so far, marked
         # "aborted" — the report and post-mortems read it
         if flight is not None:
+            # a collective timeout / stale peer is the survivor's view of a
+            # PEER's death: dump it under its own trigger kind so the fleet
+            # postmortem separates "I crashed" from "my peer vanished"
+            kind = (
+                "peer_lost"
+                if isinstance(
+                    exc,
+                    (
+                        robust_dist.DistributedTimeoutError,
+                        robust_dist.PeerLostError,
+                    ),
+                )
+                else "crash"
+            )
             try:
                 flight.trigger(
-                    "crash", detail=f"{type(exc).__name__}: {exc}"
+                    kind, detail=f"{type(exc).__name__}: {exc}"
                 )
             except Exception:
                 obs.swallowed_error("cli.flightrec_crash_dump")
@@ -402,6 +460,9 @@ def run(argv: Optional[List[str]] = None) -> Dict:
                 logger.exception("could not flush partial run summary")
         raise
     finally:
+        if hb_writer is not None:
+            hb_writer.stop()
+        robust_dist.clear_collectives()
         if status_server is not None:
             status_server.stop()
         if run_t is not None:
@@ -712,6 +773,21 @@ def _run_training(args, run_t, metric_sinks, t_run0, recorder=None) -> Dict:
     # outer-loop state at coordinate-update boundaries, resume bit-exact
     cd_manager = None
     resume_snap = None
+    ckpt_topology = None
+    if args.checkpoint_every or args.resume:
+        from ..plan import plan_fingerprint
+
+        # the topology contract both sides of a checkpoint speak: saves
+        # stamp it into the manifest, resumes judge the saved stamp through
+        # plan.check_checkpoint_topology. global_rows is the PADDED total
+        # (equal_host_share rows per process), so the number itself encodes
+        # whether per-host shard boundaries agree across process counts
+        ckpt_topology = {
+            "n_processes": multihost.process_count(),
+            "mesh_axes": estimator.execution_plan.mesh_axes,
+            "plan_fingerprint": plan_fingerprint(estimator.execution_plan),
+            "global_rows": int(raw.n_rows) * multihost.process_count(),
+        }
     if args.checkpoint_every:
         if not args.checkpoint_dir:
             raise SystemExit("--checkpoint-every requires --checkpoint-dir")
@@ -719,6 +795,12 @@ def _run_training(args, run_t, metric_sinks, t_run0, recorder=None) -> Dict:
             os.path.join(args.checkpoint_dir, "cd-boundaries"),
             keep_last=args.checkpoint_keep,
             every=args.checkpoint_every,
+            process=multihost.process_index(),
+            n_processes=multihost.process_count(),
+            topology={
+                "mesh_axes": ckpt_topology["mesh_axes"],
+                "plan_fingerprint": ckpt_topology["plan_fingerprint"],
+            },
         )
     if args.resume:
         if not args.checkpoint_dir:
@@ -731,7 +813,8 @@ def _run_training(args, run_t, metric_sinks, t_run0, recorder=None) -> Dict:
         # broadcast so non-shared filesystems resume consistently
         if multihost.is_coordinator():
             resume_snap = mgr.latest_valid(
-                expect_coordinate_order=[cc.name for cc in coords]
+                expect_coordinate_order=[cc.name for cc in coords],
+                expect_topology=ckpt_topology,
             )
         if multihost.process_count() > 1:
             resume_snap = multihost.broadcast_object(resume_snap)
@@ -1356,10 +1439,13 @@ class _Checkpoint:
                 n_trials = len(self.state.get("tuning_trials", []))
 
                 def boundary(reg_weights, st, _k=k, _done=done, _n=n_trials):
-                    # coordinator-only like _save_model: boundary snapshots
-                    # live on the coordinator's filesystem and broadcast on
-                    # resume
-                    if multihost.is_coordinator():
+                    # single-process: coordinator-only like _save_model
+                    # (boundary snapshots live on the coordinator's
+                    # filesystem and broadcast on resume). A distributed
+                    # manager instead needs EVERY process at the boundary:
+                    # phase one writes each process's score shard and the
+                    # confirm exchange is itself a collective
+                    if cd_manager.n_processes > 1 or multihost.is_coordinator():
                         cd_manager.on_boundary(
                             st,
                             meta={

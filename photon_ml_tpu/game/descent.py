@@ -24,6 +24,7 @@ import numpy as np
 
 from .. import obs
 from ..analysis.runtime import allow_transfers, logged_fetch, transfer_guard
+from ..robust import distributed as robust_dist
 from ..robust import faults
 from ..evaluation.suite import EvaluationResults, EvaluationSuite
 from ..models.game import GameModel
@@ -486,6 +487,12 @@ class CoordinateDescent:
                 if self.checkpoint_fn is not None:
                     with obs.span("cd.checkpoint", phase="checkpoint"):
                         self.checkpoint_fn(it, dict(models))
+            # sweep-boundary liveness rendezvous: in a distributed run every
+            # process must reach the end of the sweep within the collective
+            # budget — a dead peer surfaces here as a typed timeout instead
+            # of a hang inside next sweep's collectives. Also the once-per-
+            # sweep `dist.collective` fault site (the kill-a-worker drill).
+            robust_dist.sweep_barrier(it)
             # memory watermarks at the sweep boundary (host RSS via /proc,
             # device HBM via memory_stats when the backend has it): cheap
             # host-only reads, recorded with or without a sink so the peaks

@@ -14,7 +14,12 @@ postmortem file the moment a trigger fires:
 - ``coordinate_rejection`` — ``photon_coordinate_rejections_total`` moved;
 - ``crash`` — explicit :meth:`FlightRecorder.trigger` from the driver's
   crash-flush path (``cli train`` composes it with the ``aborted``
-  run-summary flush).
+  run-summary flush);
+- ``peer_lost`` — a distributed run hit a collective timeout or stale-peer
+  detection (:mod:`robust.distributed`): every surviving process dumps its
+  own postmortem of the window around the peer's death before exiting
+  nonzero, so the fleet-level question "what was each survivor doing when
+  worker N died" is answerable from the dumps alone.
 
 Each trigger kind is latched with a cooldown: a sustained storm produces
 exactly ONE dump (the postmortem of its onset), not a dump per request.

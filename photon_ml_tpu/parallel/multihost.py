@@ -168,6 +168,12 @@ def allgather_object(obj):
 
     from jax.experimental import multihost_utils
 
+    from ..robust import distributed as robust_dist
+
+    # bounded-time rendezvous before the blocking collective: if any peer is
+    # dead this raises a typed DistributedTimeoutError within the armed
+    # budget instead of hanging in process_allgather forever (no-op unarmed)
+    robust_dist.guard_collective("allgather_object")
     payload = np.frombuffer(pickle.dumps(obj), dtype=np.uint8)
     sizes = multihost_utils.process_allgather(
         np.asarray([payload.size], np.int64)
@@ -193,6 +199,9 @@ def broadcast_object(obj):
 
     from jax.experimental import multihost_utils
 
+    from ..robust import distributed as robust_dist
+
+    robust_dist.guard_collective("broadcast_object")
     payload = (
         np.frombuffer(pickle.dumps(obj), dtype=np.uint8)
         if jax.process_index() == 0
@@ -206,7 +215,10 @@ def broadcast_object(obj):
     padded = np.zeros(size, np.uint8)
     padded[: payload.size] = payload[:size]
     data = multihost_utils.broadcast_one_to_all(padded)
-    return pickle.loads(np.asarray(data).tobytes())
+    # broadcast_one_to_all may hand the psum result back in a promoted
+    # integer dtype (uint8 -> int64 under x64); reinterpreting THAT buffer
+    # as bytes interleaves zeros into the pickle stream — cast back first
+    return pickle.loads(np.asarray(data).astype(np.uint8).tobytes())
 
 
 @functools.lru_cache(maxsize=32)

@@ -15,9 +15,11 @@ from .planner import (
     CoordinatePlan,
     ExecutionPlan,
     PlanError,
+    check_checkpoint_topology,
     check_lane_composition,
     check_multiprocess_mesh,
     check_retrain_composition,
+    plan_fingerprint,
     resolve,
 )
 
@@ -25,8 +27,10 @@ __all__ = [
     "CoordinatePlan",
     "ExecutionPlan",
     "PlanError",
+    "check_checkpoint_topology",
     "check_lane_composition",
     "check_multiprocess_mesh",
     "check_retrain_composition",
+    "plan_fingerprint",
     "resolve",
 ]

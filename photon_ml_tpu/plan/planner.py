@@ -22,7 +22,9 @@ Geometry that needs the streaming helpers imports them lazily.
 from __future__ import annotations
 
 import dataclasses
-from typing import Dict, Optional, Sequence, Tuple
+import hashlib
+import json
+from typing import Dict, Mapping, Optional, Sequence, Tuple
 
 
 class PlanError(ValueError):
@@ -344,6 +346,99 @@ def check_retrain_composition(
             "composable with hbm.budget.mb streaming (the per-day entity "
             f"merge carries host-resident models forward) — remove "
             f"hbm.budget.mb from {sorted(streamed)}"
+        )
+
+
+# -- checkpoint topology (resume legality across topology changes) ----------
+
+
+def plan_fingerprint(plan: ExecutionPlan) -> str:
+    """A stable digest of the plan facts that must MATCH for a checkpoint
+    to be resumable: the coordinate set and each coordinate's layout,
+    feature dtype, kind and residency, plus the normalization mode.
+    Deliberately topology-INDEPENDENT — mesh axes, process count, sharding
+    and pipelining are excluded, so a legal reshape (same model, different
+    process count) keeps its fingerprint while a changed coordinate
+    configuration (which would silently train a different model) does not."""
+    facts = {
+        "coordinates": [
+            {
+                "name": c.name,
+                "kind": c.kind,
+                "layout": c.layout,
+                "feature_dtype": c.feature_dtype,
+                "residency": c.residency,
+            }
+            for c in plan.coordinates
+        ],
+        "normalization": plan.normalization,
+    }
+    blob = json.dumps(facts, sort_keys=True).encode("utf-8")
+    return hashlib.sha256(blob).hexdigest()[:16]
+
+
+def check_checkpoint_topology(
+    saved: Mapping, current: Mapping
+) -> None:
+    """Judge whether a checkpoint written under ``saved`` topology may be
+    restored by a run under ``current`` topology. Keys (each optional — a
+    missing key skips its check, so manifests that predate this protocol
+    restore as before): ``n_processes``, ``mesh_axes``, ``global_rows``
+    (the PADDED global row total — ``equal_host_share`` padding means the
+    total itself encodes whether per-host boundaries agree), and
+    ``plan_fingerprint`` (:func:`plan_fingerprint`).
+
+    Legal: identical topology (bit-exact resume), and a data-axis process
+    count change whose padded global row totals agree (the restore path
+    re-concatenates row shards in process order). Everything else raises a
+    ledger-pinned :class:`PlanError`."""
+
+    def _axes(t: Mapping) -> Optional[Dict[str, int]]:
+        try:
+            return _mesh_axes(t.get("mesh_axes"))
+        except TypeError:
+            return None
+
+    saved_model = (_axes(saved) or {}).get(MODEL_AXIS, 1)
+    current_model = (_axes(current) or {}).get(MODEL_AXIS, 1)
+    if saved_model != current_model:
+        # model-axis shards are per-program solver state, not row blocks:
+        # there is no host-side re-concatenation that reassembles them
+        raise PlanError(
+            "checkpoint mesh reshape across the model axis is not "
+            f"supported: the checkpoint was saved with model={saved_model}, "
+            f"this run uses model={current_model}; resume on a mesh with "
+            "the same model axis (data-axis reshapes are the legal ones)"
+        )
+    saved_p, current_p = saved.get("n_processes"), current.get("n_processes")
+    saved_rows = saved.get("global_rows")
+    current_rows = current.get("global_rows")
+    if (
+        saved_p is not None
+        and current_p is not None
+        and int(saved_p) != int(current_p)
+        and saved_rows is not None
+        and current_rows is not None
+        and int(saved_rows) != int(current_rows)
+    ):
+        raise PlanError(
+            "cannot resume: the process count changed and no legal reshape "
+            f"exists — the padded global row totals disagree ({saved_rows} "
+            f"rows saved under {saved_p} process(es), {current_rows} under "
+            f"{current_p}: per-host padding rows would land inside the "
+            "data); rerun with the original process count, or a row count "
+            "whose per-host padding agrees"
+        )
+    saved_fp = saved.get("plan_fingerprint")
+    current_fp = current.get("plan_fingerprint")
+    if saved_fp and current_fp and saved_fp != current_fp:
+        raise PlanError(
+            "resuming across a changed execution plan is not supported: "
+            f"the checkpoint's plan fingerprint {saved_fp} != this run's "
+            f"{current_fp} (the coordinate set, a layout, a feature dtype "
+            "or a residency changed — the snapshot would silently train a "
+            "different model); rerun the original configuration or start a "
+            "fresh checkpoint directory"
         )
 
 

@@ -17,10 +17,18 @@ guarantees in library form:
   ``--resume`` replays the remaining updates);
 - :mod:`robust.faults` — a deterministic, seeded fault injector (default
   off, env-activated) that makes the first three testable: injected IO
-  errors exercise the retry budget, simulated kills exercise resume.
+  errors exercise the retry budget, simulated kills exercise resume;
+- :mod:`robust.distributed` — multi-process liveness (the scheduler
+  property): per-process heartbeat records, stale-peer detection
+  (:class:`PeerLostError`), and bounded-time collective barriers that turn
+  a dead peer into a typed :class:`DistributedTimeoutError` within a
+  configured budget instead of an infinite hang; checkpoints become
+  cross-process consistent via the two-phase protocol in
+  :mod:`robust.checkpoint`.
 
 ``cli.train --checkpoint-dir D --checkpoint-every N`` / ``--resume`` wire
-this end to end.
+this end to end; ``--collective-timeout`` / ``--heartbeat-interval`` arm
+the distributed liveness plane.
 """
 
 from .atomic import (
@@ -34,6 +42,19 @@ from .checkpoint import (
     CheckpointIncompatibleError,
     CheckpointManager,
     CheckpointSnapshot,
+)
+from .distributed import (
+    DistributedError,
+    DistributedTimeoutError,
+    HeartbeatWriter,
+    PeerLostError,
+    barrier_with_timeout,
+    check_peers,
+    clear_collectives,
+    configure_collectives,
+    heartbeat_ages,
+    read_heartbeats,
+    write_heartbeat,
 )
 from .faults import (
     FaultInjector,
@@ -50,15 +71,26 @@ __all__ = [
     "CheckpointManager",
     "CheckpointSnapshot",
     "DEFAULT_IO_POLICY",
+    "DistributedError",
+    "DistributedTimeoutError",
     "FaultInjector",
     "FaultSpec",
+    "HeartbeatWriter",
     "InjectedIOError",
+    "PeerLostError",
     "RetryPolicy",
     "SimulatedKill",
     "atomic_write",
     "atomic_write_bytes",
     "atomic_write_json",
     "atomic_write_text",
+    "barrier_with_timeout",
+    "check_peers",
+    "clear_collectives",
+    "configure_collectives",
+    "heartbeat_ages",
     "io_call",
     "parse_faults",
+    "read_heartbeats",
+    "write_heartbeat",
 ]

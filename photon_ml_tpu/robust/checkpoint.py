@@ -26,6 +26,24 @@ the resuming run is REJECTED with a clear error instead of half-loading.
 Counters in the obs registry: ``photon_checkpoint_saves_total``,
 ``photon_checkpoint_bytes_total``, ``photon_checkpoint_restore_total``, and
 ``photon_checkpoint_skipped_total{reason=}`` for restore fallbacks.
+
+**Multi-process runs** use a two-phase boundary protocol (the manager is
+constructed with ``process``/``n_processes`` and every process calls
+:meth:`CheckpointManager.on_boundary`): phase one, each process writes its
+local row shard of the summed scores (``shard-p<i>.pkl``) and confirms it
+over a guarded collective with the shard's sha256; phase two, the
+coordinator — and only after every shard confirmed — writes the payload and
+then the manifest, which records all shard digests plus the run topology
+(process count, mesh axes, plan fingerprint, padded global rows). The
+manifest is still the commit point: a save torn at ANY stage (shard,
+payload, or pre-manifest kill — the ``dist.commit`` fault site brackets
+both phases) leaves no manifest, so restore falls back to the previous
+consistent step exactly like a corrupt single-process checkpoint. Restore
+validates the recorded topology through the plan layer
+(:func:`plan.planner.check_checkpoint_topology`): same topology resumes
+bit-exact, a legal reshape (data-axis shards re-concatenated under a
+different process count with identical padded row totals) reassembles the
+shards, and an unsound one raises :class:`CheckpointIncompatibleError`.
 """
 
 from __future__ import annotations
@@ -50,6 +68,7 @@ logger = logging.getLogger("photon_ml_tpu")
 
 MANIFEST_NAME = "MANIFEST.json"
 PAYLOAD_NAME = "state.pkl"
+SHARD_PREFIX = "shard-p"
 MANIFEST_VERSION = 1
 _DIR_PREFIX = "ckpt-"
 
@@ -104,6 +123,14 @@ class CheckpointManager:
     counts them); ``keep_last``: checkpoints retained after rotation;
     ``fsync``: durability of the temp-write path (tests turn it off for
     speed, production leaves it on).
+
+    ``process``/``n_processes`` select the two-phase multi-process protocol
+    (every process constructs a manager over the SAME directory and calls
+    :meth:`on_boundary`; shard confirmation rides ``exchange``, which
+    defaults to the guarded ``multihost.allgather_object`` and is injectable
+    for in-process torn-commit tests). ``topology`` is extra topology meta
+    (mesh axes, plan fingerprint) stamped into every manifest alongside the
+    process count and padded global row total.
     """
 
     def __init__(
@@ -113,15 +140,29 @@ class CheckpointManager:
         every: int = 1,
         fsync: bool = True,
         base_meta: Optional[dict] = None,
+        process: int = 0,
+        n_processes: int = 1,
+        topology: Optional[dict] = None,
+        exchange=None,
     ):
         if keep_last < 1:
             raise ValueError(f"keep_last must be >= 1: {keep_last}")
         if every < 1:
             raise ValueError(f"every must be >= 1: {every}")
+        if n_processes < 1:
+            raise ValueError(f"n_processes must be >= 1: {n_processes}")
+        if not 0 <= process < n_processes:
+            raise ValueError(
+                f"process must be in [0, {n_processes}): {process}"
+            )
         self.directory = directory
         self.keep_last = keep_last
         self.every = every
         self.fsync = fsync
+        self.process = int(process)
+        self.n_processes = int(n_processes)
+        self.topology = dict(topology or {})
+        self.exchange = exchange
         # merged into every manifest this manager writes (per-save meta wins
         # on key collisions): the retrain chain stamps its day index and the
         # accepted/rejected ledger here, so any boundary checkpoint alone
@@ -148,25 +189,12 @@ class CheckpointManager:
         return path
 
     def save(self, state, meta: Optional[dict] = None) -> str:
-        """Persist one boundary state; returns the checkpoint directory."""
+        """Persist one boundary state; returns the checkpoint directory.
+        Multi-process managers route through the two-phase protocol."""
+        if self.n_processes > 1:
+            return self._save_distributed(state, meta)
         t0 = time.perf_counter()
-        payload = {
-            "iteration": int(state.iteration),
-            "coordinate_index": int(state.coordinate_index),
-            "coordinate": state.coordinate,
-            "models": dict(state.models),
-            "summed_scores": np.asarray(state.summed_scores),
-            "best_eval": state.best_eval,
-            "best_models": dict(state.best_models),
-            "evaluations": list(state.evaluations),
-            "tracker_summaries": {
-                name: t.to_summary_string() for name, t in state.trackers.items()
-            },
-            "train_losses": {
-                k: float(v)
-                for k, v in (getattr(state, "train_losses", None) or {}).items()
-            },
-        }
+        payload = self._payload_dict(state, np.asarray(state.summed_scores))
         blob = pickle.dumps(payload, protocol=pickle.HIGHEST_PROTOCOL)
         digest = hashlib.sha256(blob).hexdigest()
         name = f"{_DIR_PREFIX}{self._seq:06d}"
@@ -191,6 +219,9 @@ class CheckpointManager:
             "sha256": digest,
             "bytes": len(blob),
             "created_unix": time.time(),
+            "topology": self._topology_meta(
+                global_rows=int(payload["summed_scores"].shape[0])
+            ),
             **self.base_meta,
             **(meta or {}),
         }
@@ -222,6 +253,152 @@ class CheckpointManager:
         )
         return ckpt_dir
 
+    @staticmethod
+    def _payload_dict(state, summed_scores) -> dict:
+        return {
+            "iteration": int(state.iteration),
+            "coordinate_index": int(state.coordinate_index),
+            "coordinate": state.coordinate,
+            "models": dict(state.models),
+            "summed_scores": summed_scores,
+            "best_eval": state.best_eval,
+            "best_models": dict(state.best_models),
+            "evaluations": list(state.evaluations),
+            "tracker_summaries": {
+                name: t.to_summary_string() for name, t in state.trackers.items()
+            },
+            "train_losses": {
+                k: float(v)
+                for k, v in (getattr(state, "train_losses", None) or {}).items()
+            },
+        }
+
+    def _topology_meta(self, global_rows: int) -> dict:
+        return {
+            **self.topology,
+            "n_processes": self.n_processes,
+            "global_rows": int(global_rows),
+        }
+
+    def _local_shard(self, summed_scores) -> np.ndarray:
+        """This process's rows of the summed scores. A globally sharded
+        jax.Array yields the addressable rows (``host_local_rows``); a
+        host-local array (replicated small runs, in-process tests) is
+        already the shard."""
+        try:
+            import jax
+        except Exception:  # photon: ignore[R4] - no-jax fallback: host array
+            return np.asarray(summed_scores)
+        if isinstance(summed_scores, jax.Array) and jax.process_count() > 1:
+            from ..parallel import multihost
+
+            return np.asarray(multihost.host_local_rows(summed_scores))
+        return np.asarray(summed_scores)
+
+    def _save_distributed(self, state, meta: Optional[dict] = None) -> str:
+        """Two-phase consistent save across ``n_processes`` (see module
+        docstring). Phase one (all processes): write the local summed-score
+        shard, confirm its digest over the exchange collective. Phase two
+        (coordinator): payload, then — the commit point — the manifest. The
+        ``dist.commit`` fault site fires at phase-one entry and again on the
+        coordinator right before the manifest, so tests can tear the save
+        at either stage and watch restore fall back."""
+        t0 = time.perf_counter()
+        faults.check("dist.commit")
+        name = f"{_DIR_PREFIX}{self._seq:06d}"
+        ckpt_dir = os.path.join(self.directory, name)
+        os.makedirs(ckpt_dir, exist_ok=True)
+        local = self._local_shard(state.summed_scores)
+        shard_blob = pickle.dumps(
+            {"process": self.process, "summed_scores": local},
+            protocol=pickle.HIGHEST_PROTOCOL,
+        )
+        shard_name = f"{SHARD_PREFIX}{self.process}.pkl"
+        io_call(
+            atomic_write_bytes,
+            os.path.join(ckpt_dir, shard_name),
+            shard_blob,
+            fsync=self.fsync,
+            site="checkpoint.write",
+        )
+        confirm = {
+            "process": self.process,
+            "file": shard_name,
+            "sha256": hashlib.sha256(shard_blob).hexdigest(),
+            "bytes": len(shard_blob),
+            "rows": int(local.shape[0]),
+        }
+        exchange = self.exchange
+        if exchange is None:
+            from ..parallel import multihost
+
+            exchange = multihost.allgather_object
+        confirms = sorted(exchange(confirm), key=lambda c: c["process"])
+        # every process advances in lockstep past the exchange barrier, so
+        # the NEXT boundary's directory name agrees even if this commit tears
+        self._seq += 1
+        if self.process != 0:
+            return ckpt_dir
+        payload = self._payload_dict(state, None)
+        blob = pickle.dumps(payload, protocol=pickle.HIGHEST_PROTOCOL)
+        digest = hashlib.sha256(blob).hexdigest()
+        io_call(
+            atomic_write_bytes,
+            os.path.join(ckpt_dir, PAYLOAD_NAME),
+            blob,
+            fsync=self.fsync,
+            site="checkpoint.write",
+        )
+        # commit point: shards + payload are durable, the manifest is not —
+        # a kill here is the torn save restore must survive
+        faults.check("dist.commit")
+        manifest = {
+            "version": MANIFEST_VERSION,
+            "step": self._seq - 1,
+            "iteration": int(state.iteration),
+            "coordinate_index": int(state.coordinate_index),
+            "coordinate": state.coordinate,
+            "coordinate_order": list(state.coordinate_order),
+            "n_iterations": int(state.n_iterations),
+            "payload": PAYLOAD_NAME,
+            "sha256": digest,
+            "bytes": len(blob),
+            "created_unix": time.time(),
+            "shards": confirms,
+            "topology": self._topology_meta(
+                global_rows=sum(c["rows"] for c in confirms)
+            ),
+            **self.base_meta,
+            **(meta or {}),
+        }
+        io_call(
+            atomic_write_json,
+            os.path.join(ckpt_dir, MANIFEST_NAME),
+            manifest,
+            fsync=self.fsync,
+            indent=2,
+            site="checkpoint.manifest",
+        )
+        total_bytes = len(blob) + sum(c["bytes"] for c in confirms)
+        save_seconds = time.perf_counter() - t0
+        reg = _registry()
+        reg.counter(
+            "photon_checkpoint_saves_total", "boundary checkpoints written"
+        ).inc()
+        reg.counter(
+            "photon_checkpoint_bytes_total", "checkpoint payload bytes written"
+        ).inc(total_bytes)
+        reg.histogram(
+            "photon_checkpoint_save_seconds", "wall per boundary checkpoint save"
+        ).observe(save_seconds)
+        self._rotate()
+        logger.info(
+            "checkpoint %s: iter %d coordinate %s (%d procs, %d bytes, %.3fs)",
+            name, manifest["iteration"], manifest["coordinate"],
+            self.n_processes, total_bytes, save_seconds,
+        )
+        return ckpt_dir
+
     def _rotate(self) -> None:
         steps = sorted(self._steps_on_disk())
         for step in steps[: max(0, len(steps) - self.keep_last)]:
@@ -246,13 +423,18 @@ class CheckpointManager:
         self,
         expect_coordinate_order: Optional[Sequence[str]] = None,
         expect_n_iterations: Optional[int] = None,
+        expect_topology: Optional[dict] = None,
     ) -> Optional[CheckpointSnapshot]:
         """Newest checkpoint that passes manifest + digest validation,
         falling back past corrupt ones (each skip warned and counted).
         ``expect_*`` pins the run configuration: the newest VALID checkpoint
         failing those checks raises :class:`CheckpointIncompatibleError` —
         silently resuming an incompatible snapshot (or silently skipping to
-        a stale compatible one) would both train the wrong model."""
+        a stale compatible one) would both train the wrong model.
+        ``expect_topology`` is the resuming run's topology (process count,
+        mesh axes, plan fingerprint, padded global rows), judged by the plan
+        layer: a mismatch with no legal reshape is a refusal, not a shape
+        crash deep in the sweep."""
         for step in sorted(self._steps_on_disk(), reverse=True):
             name = f"{_DIR_PREFIX}{step:06d}"
             ckpt_dir = os.path.join(self.directory, name)
@@ -282,6 +464,17 @@ class CheckpointManager:
                     f"iterations, this run uses {expect_n_iterations}; "
                     "refusing to resume — pass a fresh checkpoint directory"
                 )
+            if expect_topology is not None:
+                from ..plan import PlanError, planner
+
+                try:
+                    planner.check_checkpoint_topology(
+                        manifest.get("topology") or {}, expect_topology
+                    )
+                except PlanError as e:
+                    raise CheckpointIncompatibleError(
+                        f"checkpoint {ckpt_dir}: {e}"
+                    ) from e
             _registry().counter(
                 "photon_checkpoint_restore_total", "checkpoints restored"
             ).inc()
@@ -330,7 +523,33 @@ class CheckpointManager:
                 f"payload digest {digest[:12]}... != manifest "
                 f"{manifest['sha256'][:12]}... (truncated or corrupt write)"
             )
-        return manifest, pickle.loads(blob)
+        payload = pickle.loads(blob)
+        shards = manifest.get("shards")
+        if shards:
+            # two-phase save: the payload carries everything except the
+            # summed scores, which live in per-process shards — verify each
+            # digest and re-concatenate in process order (row order is the
+            # global row order, so this is also how a legal reshape under a
+            # different process count reassembles)
+            parts = []
+            for rec in sorted(shards, key=lambda r: r["process"]):
+                shard_path = os.path.join(ckpt_dir, rec["file"])
+
+                def read_shard(path=shard_path):
+                    with open(path, "rb") as f:
+                        return f.read()
+
+                sblob = io_call(read_shard, site="checkpoint.read")
+                sdigest = hashlib.sha256(sblob).hexdigest()
+                if sdigest != rec["sha256"]:
+                    raise ValueError(
+                        f"shard {rec['file']} digest {sdigest[:12]}... != "
+                        f"manifest {rec['sha256'][:12]}... (torn "
+                        "multi-process save)"
+                    )
+                parts.append(np.asarray(pickle.loads(sblob)["summed_scores"]))
+            payload["summed_scores"] = np.concatenate(parts, axis=0)
+        return manifest, payload
 
     def checkpoints(self) -> List[str]:
         """Checkpoint directories on disk, oldest first (for tests/tools)."""

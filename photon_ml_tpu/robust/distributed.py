@@ -1,0 +1,433 @@
+"""Distributed fault tolerance: heartbeats, bounded-time collectives.
+
+The reference survives executor loss because Spark's scheduler notices a
+dead executor (missed heartbeats), fails the stage within a bounded time,
+and re-runs lost tasks from lineage. A JAX multi-process run has none of
+that by default: one dead or stalled process leaves every peer blocked
+inside the next collective *forever* — no error, no exit code, no
+postmortem. This module is the liveness layer the multi-process substrate
+was missing:
+
+- **Heartbeat plane** — each process runs a :class:`HeartbeatWriter` daemon
+  thread that writes a monotonic liveness record (``heartbeat-p<i>.json``,
+  a strictly increasing ``seq`` plus a wall stamp) into the shared run
+  directory via :mod:`robust.atomic`, so a reader never sees a torn record.
+  Peers read ages with :func:`heartbeat_ages` (exported as the
+  ``photon_dist_heartbeat_age_seconds{process=}`` gauge) and
+  :func:`check_peers` raises a typed :class:`PeerLostError` for a peer
+  whose record is stale or absent. The plane is pure host-side file IO —
+  it never touches a device, so the zero-fetch sweep is unaffected.
+
+- **Bounded-time collectives** — :func:`barrier_with_timeout` rendezvouses
+  all processes through the jax coordination service with a deadline: a
+  dead peer turns the infinite hang into a typed
+  :class:`DistributedTimeoutError` within the configured budget, decorated
+  with whatever the heartbeat plane knows about which peer died.
+  :func:`configure_collectives` arms a process-wide budget;
+  :func:`guard_collective` is the pre-collective rendezvous
+  ``parallel/multihost.py`` runs before its object collectives (if every
+  process reaches the barrier, the collective that follows has all its
+  participants), and ``game/descent.py`` calls :func:`sweep_barrier` at
+  every CD sweep boundary so a mid-sweep death is detected at the next
+  boundary. On timeout ``cli train`` dumps a ``peer_lost`` flight-recorder
+  postmortem and exits nonzero — bounded-time failure instead of a hang.
+
+Fault sites (see :mod:`robust.faults`): ``dist.heartbeat`` fires on every
+heartbeat write (``io`` starves the record so peers see staleness, ``kill``
+takes down the heartbeat thread — the closest simulation of a process whose
+liveness plane died), and ``dist.collective`` fires at sweep-boundary
+barrier entry only (``delay`` holds one process out of the rendezvous past
+the budget, ``kill`` is the kill-a-worker drill — the peer dies, the
+survivor times out). The two-phase checkpoint commit has its own
+``dist.commit`` site in :mod:`robust.checkpoint`.
+
+Single-process behavior is identical to before: every entry point degrades
+to a no-op when the process count is 1 (the fault site still fires, so the
+semantics stay unit-testable without a cluster).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import logging
+import os
+import threading
+import time
+from typing import Dict, List, Optional
+
+from . import faults
+from .atomic import atomic_write_json
+
+logger = logging.getLogger("photon_ml_tpu")
+
+HEARTBEAT_PREFIX = "heartbeat-p"
+
+
+class DistributedError(RuntimeError):
+    """Base class for distributed liveness failures."""
+
+
+class PeerLostError(DistributedError):
+    """A peer process's heartbeat is stale or absent — it is presumed dead
+    (or wedged), and collectives involving it will not complete."""
+
+
+class DistributedTimeoutError(DistributedError):
+    """A collective rendezvous did not complete within the configured
+    budget — at least one peer never arrived. Raised instead of hanging."""
+
+
+def _registry():
+    from .. import obs
+
+    return obs.current_run().registry
+
+
+# -- the heartbeat plane ------------------------------------------------------
+
+
+def heartbeat_path(run_dir: str, process: int) -> str:
+    return os.path.join(run_dir, f"{HEARTBEAT_PREFIX}{int(process)}.json")
+
+
+def write_heartbeat(
+    run_dir: str, process: int, seq: int, fsync: bool = False
+) -> str:
+    """Write one liveness record (atomic: temp + rename, never torn).
+
+    ``seq`` is the writer's monotonic beat counter — a reader can detect a
+    wedged writer by the seq not advancing even when clocks disagree; the
+    ``unix`` stamp is what :func:`heartbeat_ages` measures against (same
+    host in the drills; NTP-synced hosts in a real fleet)."""
+    faults.check("dist.heartbeat")
+    path = heartbeat_path(run_dir, process)
+    atomic_write_json(
+        path,
+        {
+            "process": int(process),
+            "seq": int(seq),
+            "unix": time.time(),
+            "pid": os.getpid(),
+        },
+        fsync=fsync,
+    )
+    return path
+
+
+def read_heartbeats(run_dir: str) -> Dict[int, dict]:
+    """All liveness records under ``run_dir``, by process index. A torn or
+    unreadable record is skipped (the atomic writer makes that unreachable
+    except mid-crash; a skipped record simply reads as a missing peer)."""
+    out: Dict[int, dict] = {}
+    if not os.path.isdir(run_dir):
+        return out
+    for name in os.listdir(run_dir):
+        if not name.startswith(HEARTBEAT_PREFIX) or not name.endswith(".json"):
+            continue
+        try:
+            with open(os.path.join(run_dir, name), encoding="utf-8") as f:
+                rec = json.load(f)
+            out[int(rec["process"])] = rec
+        except (OSError, ValueError, KeyError, TypeError):
+            continue
+    return out
+
+
+def heartbeat_ages(
+    run_dir: str, now: Optional[float] = None, record_metric: bool = True
+) -> Dict[int, float]:
+    """Seconds since each process's last beat, by process index; also sets
+    the ``photon_dist_heartbeat_age_seconds{process=}`` gauge."""
+    now = time.time() if now is None else now
+    ages = {
+        p: max(0.0, now - float(rec.get("unix", 0.0)))
+        for p, rec in read_heartbeats(run_dir).items()
+    }
+    if record_metric and ages:
+        gauge = _registry().gauge(
+            "photon_dist_heartbeat_age_seconds",
+            "seconds since each process's last liveness beat",
+        )
+        for p, age in ages.items():
+            gauge.labels(process=str(p)).set(age)
+    return ages
+
+
+def stale_peers(
+    run_dir: str,
+    n_processes: int,
+    stale_after_s: float,
+    self_process: Optional[int] = None,
+    now: Optional[float] = None,
+) -> List[int]:
+    """Peer process indices whose heartbeat is older than ``stale_after_s``
+    or absent entirely (never started, or records unreadable)."""
+    ages = heartbeat_ages(run_dir, now=now)
+    return [
+        p
+        for p in range(int(n_processes))
+        if p != self_process and ages.get(p, float("inf")) > stale_after_s
+    ]
+
+
+def check_peers(
+    run_dir: str,
+    n_processes: int,
+    stale_after_s: float,
+    self_process: Optional[int] = None,
+    now: Optional[float] = None,
+) -> None:
+    """Raise :class:`PeerLostError` naming every stale/absent peer."""
+    stale = stale_peers(
+        run_dir, n_processes, stale_after_s, self_process=self_process, now=now
+    )
+    if stale:
+        ages = heartbeat_ages(run_dir, now=now, record_metric=False)
+        detail = ", ".join(
+            f"p{p}={ages[p]:.1f}s" if p in ages else f"p{p}=never"
+            for p in stale
+        )
+        raise PeerLostError(
+            f"peer process(es) {stale} presumed lost: last heartbeat older "
+            f"than {stale_after_s:.1f}s ({detail}) under {run_dir}"
+        )
+
+
+class HeartbeatWriter:
+    """Daemon thread beating every ``interval_s`` into ``run_dir``.
+
+    A failed beat (transient FS error, or an injected ``dist.heartbeat:io``)
+    is swallowed and counted — the next beat repairs the record; only a
+    ``dist.heartbeat:kill`` (a :class:`~robust.faults.SimulatedKill`, a
+    ``BaseException``) takes the thread down, which is exactly the
+    starved-liveness-plane drill: the process keeps computing but its peers
+    stop hearing from it."""
+
+    def __init__(
+        self,
+        run_dir: str,
+        process: int,
+        interval_s: float = 1.0,
+        fsync: bool = False,
+    ):
+        if interval_s <= 0:
+            raise ValueError(f"heartbeat interval must be > 0: {interval_s}")
+        self.run_dir = run_dir
+        self.process = int(process)
+        self.interval_s = float(interval_s)
+        self.fsync = fsync
+        self.seq = 0
+        os.makedirs(run_dir, exist_ok=True)
+        self._stop = threading.Event()
+        self._thread = threading.Thread(
+            target=self._run, name=f"photon-heartbeat-p{self.process}",
+            daemon=True,
+        )
+
+    def start(self) -> "HeartbeatWriter":
+        self.beat()  # first record lands before any peer could check
+        self._thread.start()
+        return self
+
+    def stop(self, timeout: float = 5.0) -> None:
+        self._stop.set()
+        if self._thread.is_alive():
+            self._thread.join(timeout=timeout)
+
+    def beat(self) -> None:
+        """One synchronous liveness write (the thread loop's body)."""
+        # main writes once in start() BEFORE the thread exists; after the
+        # handoff only the beat thread touches it
+        self.seq = self.seq + 1  # photon: thread-confined
+        write_heartbeat(
+            self.run_dir, self.process, self.seq, fsync=self.fsync
+        )
+
+    def _run(self) -> None:
+        from .. import obs
+
+        while not self._stop.wait(self.interval_s):
+            try:
+                self.beat()
+            except Exception:
+                # transient: the record simply ages one more interval
+                obs.swallowed_error("dist.heartbeat")
+
+
+# -- bounded-time collectives -------------------------------------------------
+
+
+@dataclasses.dataclass(frozen=True)
+class CollectiveGuard:
+    """The armed collective-timeout configuration (process-wide)."""
+
+    timeout_s: float
+    run_dir: Optional[str] = None  # heartbeat dir, for timeout diagnosis
+    stale_after_s: float = 10.0
+
+
+_guard: Optional[CollectiveGuard] = None
+_barrier_lock = threading.Lock()
+_barrier_seq: Dict[str, int] = {}
+
+
+def configure_collectives(
+    timeout_s: float,
+    run_dir: Optional[str] = None,
+    stale_after_s: float = 10.0,
+) -> None:
+    """Arm the process-wide collective budget (``cli train`` does this for
+    distributed runs; ``timeout_s <= 0`` disarms). Every process must arm
+    the same budget — the barrier ids are call-ordered, so configuration
+    itself needs no collective."""
+    global _guard
+    if timeout_s and timeout_s > 0:
+        _guard = CollectiveGuard(
+            timeout_s=float(timeout_s),
+            run_dir=run_dir,
+            stale_after_s=float(stale_after_s),
+        )
+    else:
+        _guard = None
+
+
+def clear_collectives() -> None:
+    """Disarm (and reset barrier sequencing — test isolation)."""
+    global _guard
+    _guard = None
+    with _barrier_lock:
+        _barrier_seq.clear()
+
+
+def collective_timeout() -> Optional[float]:
+    g = _guard
+    return g.timeout_s if g is not None else None
+
+
+def _process_count() -> int:
+    """Process count without requiring an initialized backend (unit tests
+    with no distributed runtime see 1)."""
+    try:
+        import jax
+
+        return jax.process_count()
+    except Exception:  # photon: ignore[R4] - no-jax fallback, single process
+        return 1
+
+
+def _coordination_client():
+    """The jax distributed-runtime client, or None when the coordination
+    service is not up (single-process, or pre-initialize)."""
+    try:
+        from jax._src import distributed as _dist
+
+        return getattr(_dist.global_state, "client", None)
+    except Exception:  # photon: ignore[R4] - no-jax fallback, no client
+        return None
+
+
+def _next_barrier_id(name: str) -> str:
+    # barrier ids must be unique per use and identical across processes:
+    # calls are SPMD-ordered, so a per-name counter agrees everywhere
+    with _barrier_lock:
+        n = _barrier_seq.get(name, 0) + 1
+        _barrier_seq[name] = n
+    return f"photon:{name}:{n}"
+
+
+def barrier_with_timeout(
+    name: str,
+    timeout_s: Optional[float] = None,
+    fault_site: Optional[str] = "dist.collective",
+) -> None:
+    """Rendezvous all processes within ``timeout_s`` (defaults to the armed
+    budget) or raise :class:`DistributedTimeoutError`.
+
+    The fault site fires before the rendezvous — ``dist.collective:delay``
+    holds THIS process out of the barrier (its peers time out if the delay
+    exceeds their budget), ``dist.collective:kill`` dies at the boundary.
+    Single-process: the site still fires, the rendezvous is a no-op."""
+    if fault_site:
+        faults.check(fault_site)
+    g = _guard
+    budget = timeout_s if timeout_s is not None else (
+        g.timeout_s if g is not None else None
+    )
+    if _process_count() == 1:
+        return
+    if budget is None:
+        return  # unarmed: collectives keep their historical blocking shape
+    client = _coordination_client()
+    if client is None or not hasattr(client, "wait_at_barrier"):
+        logger.warning(
+            "collective budget armed but no coordination client; barrier "
+            "%s degraded to a no-op", name,
+        )
+        return
+    barrier_id = _next_barrier_id(name)
+    t0 = time.perf_counter()
+    try:
+        client.wait_at_barrier(barrier_id, int(budget * 1000))
+    except Exception as e:
+        # DEADLINE_EXCEEDED is the barrier running out its budget; the other
+        # markers are the coordination service noticing the dead peer first
+        # (missed service heartbeats / closed connection) and aborting the
+        # barrier early. Both mean the same thing to the caller: a peer is
+        # gone and the collective will never complete. Anything else (a
+        # mis-addressed coordinator, an auth failure) re-raises untranslated.
+        text = str(e).upper()
+        liveness = (
+            "DEADLINE", "TIMED OUT", "TIMEOUT", "UNAVAILABLE", "DISCONNECT",
+            "ABORTED", "SHUT DOWN", "SHUTTING DOWN", "HEARTBEAT",
+            "BARRIER FAILED",
+        )
+        if not any(marker in text for marker in liveness):
+            raise
+        waited = time.perf_counter() - t0
+        detail = ""
+        if g is not None and g.run_dir:
+            try:
+                stale = stale_peers(
+                    g.run_dir, _process_count(), g.stale_after_s
+                )
+                if stale:
+                    detail = f"; heartbeat-stale peers: {stale}"
+            except Exception:
+                from .. import obs
+
+                obs.swallowed_error("dist.timeout_diagnosis")
+        _registry().counter(
+            "photon_dist_collective_timeouts_total",
+            "guarded collectives that hit the budget instead of hanging",
+        ).labels(barrier=name).inc()
+        raise DistributedTimeoutError(
+            f"collective barrier {barrier_id!r} timed out after "
+            f"{waited:.1f}s (budget {budget:.1f}s): a peer process never "
+            f"arrived{detail}"
+        ) from e
+
+
+def guard_collective(name: str) -> None:
+    """Pre-collective rendezvous: called by the object collectives in
+    ``parallel/multihost.py``. If every process reaches this barrier within
+    the budget, the collective that follows has all its participants; a dead
+    peer surfaces here as a typed timeout instead of an unbounded hang.
+    No-op unless a budget is armed (and never fires the fault site — the
+    drill schedules kills at sweep boundaries, where the count is exactly
+    the sweep index)."""
+    if _guard is None:
+        return
+    barrier_with_timeout(f"pre:{name}", fault_site=None)
+
+
+def sweep_barrier(iteration: int) -> None:
+    """The CD sweep-boundary liveness rendezvous (``game/descent.py``).
+    Fires the ``dist.collective`` fault site exactly once per sweep — the
+    kill-a-worker drill's deterministic schedule — then rendezvouses under
+    the armed budget. No-op (beyond the site) when unarmed or
+    single-process."""
+    if _guard is None and _process_count() == 1:
+        faults.check("dist.collective")
+        return
+    barrier_with_timeout(f"cd.sweep.{int(iteration)}")

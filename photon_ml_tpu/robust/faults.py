@@ -61,6 +61,20 @@ resumes), and ``retrain.publish`` fires immediately before a snapshot
 publication (``retrain.publish:io:1`` is the torn-publish drill — the gate
 decision is already durable in the ledger, the previous snapshot keeps
 serving, and the next cycle repairs the store).
+
+The distributed liveness plane (``robust/distributed.py``) adds three
+process-level sites: ``dist.heartbeat`` fires on every heartbeat record
+write (``io`` starves the record so peers see staleness; ``kill`` takes
+down the heartbeat thread — a process whose liveness plane died while its
+compute continues), ``dist.collective`` fires exactly once per CD sweep at
+the sweep-boundary barrier (``dist.collective:kill:2`` on one worker is
+the kill-a-worker drill: the worker dies at its second boundary and every
+survivor gets a typed ``DistributedTimeoutError`` within the collective
+budget; ``delay`` holds a process out of the rendezvous instead), and
+``dist.commit`` brackets the two-phase checkpoint commit (phase-one entry
+on every process, plus the coordinator's pre-manifest commit point — an
+``io`` or ``kill`` at either stage tears the save and restore falls back
+to the previous consistent step).
 """
 
 from __future__ import annotations

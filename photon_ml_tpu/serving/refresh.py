@@ -152,7 +152,15 @@ class RefreshWatcher:
                 name = current_snapshot(self.serving_root)
                 if name is None or name == self._live:
                     return
-                store = ModelStore.open(snapshot_path(self.serving_root, name))
+                # retry-with-backoff INSIDE the poll (robust.retry, counted
+                # via photon_retry_attempts_total{site=}): a transient FS
+                # error while opening the snapshot recovers within this poll
+                # instead of costing a full poll interval as a one-shot miss
+                store = io_call(
+                    ModelStore.open,
+                    snapshot_path(self.serving_root, name),
+                    site="io.serving_store",
+                )
             except Exception:
                 # a torn/late publish must not take down serving: keep the live
                 # model, surface the failure in metrics, retry next poll

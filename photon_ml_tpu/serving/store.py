@@ -184,41 +184,51 @@ class ModelStore:
                 f"(this build reads version {STORE_VERSION}; re-publish the "
                 "snapshot with serving.store.build_store)"
             )
-        coords: List[object] = []
-        for c in meta["coordinates"]:
-            dt = np.dtype(c["dtype"])
-            if c["kind"] == "fixed":
-                coords.append(
-                    FixedStoreCoord(
-                        name=c["name"],
-                        feature_shard=c["shard"],
-                        weights=np.memmap(
-                            _fe_path(store_dir, c["name"]), dtype=dt, mode="r",
-                            shape=(c["dim"],),
-                        ),
+        def _open_tables() -> List[object]:
+            # mmap establishment is idempotent, so the whole loop retries as
+            # one io_call unit: a transient FS error on any artifact backs
+            # off and re-opens instead of failing the snapshot outright
+            coords: List[object] = []
+            for c in meta["coordinates"]:
+                dt = np.dtype(c["dtype"])
+                if c["kind"] == "fixed":
+                    coords.append(
+                        FixedStoreCoord(
+                            name=c["name"],
+                            feature_shard=c["shard"],
+                            weights=np.memmap(
+                                _fe_path(store_dir, c["name"]), dtype=dt,
+                                mode="r", shape=(c["dim"],),
+                            ),
+                        )
                     )
-                )
-            else:
-                shape = (c["entities"], c["support"])
-                coords.append(
-                    RandomStoreCoord(
-                        name=c["name"],
-                        feature_shard=c["shard"],
-                        random_effect_type=c["re_type"],
-                        coef_indices=np.memmap(
-                            _re_path(store_dir, c["name"], "indices"),
-                            dtype=np.int32, mode="r", shape=shape,
-                        ),
-                        coef_values=np.memmap(
-                            _re_path(store_dir, c["name"], "values"),
-                            dtype=dt, mode="r", shape=shape,
-                        ),
-                        entities=MmapIndexMap.open(
-                            _re_path(store_dir, c["name"], "entities")
-                        ),
+                else:
+                    shape = (c["entities"], c["support"])
+                    coords.append(
+                        RandomStoreCoord(
+                            name=c["name"],
+                            feature_shard=c["shard"],
+                            random_effect_type=c["re_type"],
+                            coef_indices=np.memmap(
+                                _re_path(store_dir, c["name"], "indices"),
+                                dtype=np.int32, mode="r", shape=shape,
+                            ),
+                            coef_values=np.memmap(
+                                _re_path(store_dir, c["name"], "values"),
+                                dtype=dt, mode="r", shape=shape,
+                            ),
+                            entities=MmapIndexMap.open(
+                                _re_path(store_dir, c["name"], "entities")
+                            ),
+                        )
                     )
-                )
-        return ModelStore(store_dir, meta["task"], coords)
+            return coords
+
+        return ModelStore(
+            store_dir,
+            meta["task"],
+            io_call(_open_tables, site="io.serving_store"),
+        )
 
 
 def discover_shards(model_dir: str) -> List[str]:

@@ -301,6 +301,32 @@ def _trigger_prior_index_mismatch(raw, tmp_path):
     check_prior_compatibility(model_dir, shrunk)
 
 
+def _trigger_ckpt_model_axis_reshape(raw):
+    from photon_ml_tpu.plan import planner
+
+    planner.check_checkpoint_topology(
+        {"mesh_axes": {"data": 8, "model": 1}},
+        {"mesh_axes": {"data": 4, "model": 2}},
+    )
+
+
+def _trigger_ckpt_process_count_reshape(raw):
+    from photon_ml_tpu.plan import planner
+
+    planner.check_checkpoint_topology(
+        {"n_processes": 2, "global_rows": 8},
+        {"n_processes": 3, "global_rows": 9},
+    )
+
+
+def _trigger_ckpt_plan_fingerprint(raw):
+    from photon_ml_tpu.plan import planner
+
+    planner.check_checkpoint_topology(
+        {"plan_fingerprint": "fp-aaaa"}, {"plan_fingerprint": "fp-bbbb"}
+    )
+
+
 def _trigger_chain_state_version(raw, tmp_path):
     import json
 
@@ -316,6 +342,24 @@ def _trigger_chain_state_version(raw, tmp_path):
 
 CASES = [
     # (id, documented message fragment, exception type, trigger)
+    (
+        "ckpt-model-axis-reshape",
+        "checkpoint mesh reshape across the model axis is not supported",
+        PlanError,
+        _trigger_ckpt_model_axis_reshape,
+    ),
+    (
+        "ckpt-process-count-reshape",
+        "the process count changed and no legal reshape exists",
+        PlanError,
+        _trigger_ckpt_process_count_reshape,
+    ),
+    (
+        "ckpt-plan-fingerprint",
+        "resuming across a changed execution plan is not supported",
+        PlanError,
+        _trigger_ckpt_plan_fingerprint,
+    ),
     (
         "chain-state-version",
         "unsupported chain-state version",
