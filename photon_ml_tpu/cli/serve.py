@@ -20,11 +20,26 @@ Typical flow::
     python -m photon_ml_tpu.cli.serve --serving-root out/serving \
         --listen 127.0.0.1:8473 --default-deadline-ms 50
 
+    # multi-model residency: one process, one bulkhead per model, routed
+    # by the request protocol's model= field (per-market GAME model sets)
+    python -m photon_ml_tpu.cli.serve --models jobs-us=out/serving-us \
+        --models jobs-emea=out/serving-emea --default-model jobs-us \
+        --listen 127.0.0.1:8473
+
+    # ... or discover the resident set from one fleet root (each subdir a
+    # serving root or bare store): --fleet-root out/fleet
+
+    # the replica front: N `cli serve --listen` replicas behind one address,
+    # least-loaded routing + /healthz draining + mid-request failover
+    python -m photon_ml_tpu.cli.serve --front 127.0.0.1:8473 \
+        --front 127.0.0.1:8474 --listen 127.0.0.1:9000
+
 Overload posture: the admission controller sheds requests that cannot meet
 their deadline budget (``--default-deadline-ms``, or per-request
 ``deadline_ms`` on the socket) or that meet a full pending queue
 (``--max-pending``); ``--overload-shed-threshold`` wires the shed rate into
-``/healthz`` so a balancer can route around a saturated replica.
+``/healthz`` so a balancer can route around a saturated replica — the
+``--front`` process polls exactly that endpoint (``--front-healthz``).
 """
 
 from __future__ import annotations
@@ -63,6 +78,57 @@ def build_parser() -> argparse.ArgumentParser:
         "--store-dir",
         default=None,
         help="serve one fixed mmap store directly (no refresh watching)",
+    )
+    p.add_argument(
+        "--models",
+        action="append",
+        default=None,
+        metavar="NAME=PATH",
+        help="resident model NAME served from PATH (a serving root or a "
+        "bare store dir); repeat for multi-model residency — each model "
+        "gets its own bulkhead (batcher + refresh watcher) and requests "
+        "route by the protocol's model= field",
+    )
+    p.add_argument(
+        "--fleet-root",
+        default=None,
+        help="directory whose subdirectories are the resident models "
+        "(each a serving root or bare store dir) — shorthand for one "
+        "--models entry per subdir",
+    )
+    p.add_argument(
+        "--default-model",
+        default=None,
+        help="model served to requests that carry no model= field "
+        "(default: the single resident model, or 'default')",
+    )
+    p.add_argument(
+        "--front",
+        action="append",
+        default=None,
+        metavar="HOST:PORT",
+        help="run as the least-loaded replica front instead of a scoring "
+        "server: repeat once per replica --listen address; requests route "
+        "to the live replica with the fewest in flight and fail over "
+        "(same trace_id) when a replica dies mid-request",
+    )
+    p.add_argument(
+        "--front-healthz",
+        action="append",
+        default=None,
+        metavar="HOST:PORT",
+        help="per-replica introspection address (parallel to --front): the "
+        "front drains a replica whose /healthz answers 503",
+    )
+    p.add_argument(
+        "--front-connections",
+        type=int,
+        default=1,
+        metavar="K",
+        help="connections the front opens to each replica (default 1): the "
+        "JSON-lines protocol answers in order per connection, so K is the "
+        "front's concurrency into one replica — raise it so the replica's "
+        "microbatcher sees enough in-flight requests to fill batches",
     )
     p.add_argument(
         "--publish-model",
@@ -181,8 +247,38 @@ def run(argv: Optional[List[str]] = None, stop_event=None):
         if args.publish_only:
             return None
 
-    if bool(args.serving_root) == bool(args.store_dir):
-        raise SystemExit("pass exactly one of --serving-root / --store-dir")
+    modes = (
+        args.serving_root,
+        args.store_dir,
+        args.models,
+        args.fleet_root,
+        args.front,
+    )
+    if sum(bool(m) for m in modes) != 1:
+        raise SystemExit(
+            "pass exactly one of --serving-root / --store-dir / --models / "
+            "--fleet-root / --front"
+        )
+    if args.front and not (args.socket or args.listen):
+        raise SystemExit(
+            "--front needs --socket or --listen (the fleet's one client "
+            "address)"
+        )
+    if args.front_healthz and (
+        not args.front or len(args.front_healthz) != len(args.front)
+    ):
+        raise SystemExit("--front-healthz entries must parallel --front")
+    model_pairs = None
+    if args.models:
+        # kept as (name, path) PAIRS, not a dict: a duplicate NAME must
+        # reach plan.check_fleet_composition's typed refusal, not be
+        # silently last-writer-wins'd by dict construction
+        model_pairs = []
+        for spec in args.models:
+            name, sep, path = spec.partition("=")
+            if not sep or not name or not path:
+                raise SystemExit(f"--models takes NAME=PATH (got {spec!r})")
+            model_pairs.append((name, path))
 
     # fleet identity BEFORE any sink/span exists, so every line carries it
     if args.replica_id is not None:
@@ -216,6 +312,28 @@ def run(argv: Optional[List[str]] = None, stop_event=None):
         )
         run_ctx.register_listener(flight)
     with obs.use_run(run_ctx):
+        if args.front:
+            front = serving.LeastLoadedFront(
+                args.front,
+                healthz=args.front_healthz,
+                connections_per_replica=args.front_connections,
+            )
+            logger.info(
+                "replica front over %s (socket=%s listen=%s)",
+                args.front, args.socket, args.listen,
+            )
+            try:
+                serving.serve_front_socket(
+                    front,
+                    path=args.socket,
+                    listen=args.listen,
+                    stop_event=stop_event,
+                    on_bound=lambda b: logger.info("front bound: %s", b),
+                )
+            finally:
+                front.close()
+                run_ctx.close()
+            return None
         admission = dict(
             max_pending=args.max_pending,
             default_deadline_ms=args.default_deadline_ms,
@@ -231,7 +349,7 @@ def run(argv: Optional[List[str]] = None, stop_event=None):
                 status_port=args.status_port,
                 **admission,
             )
-        else:
+        elif args.store_dir:
             server = serving.ScoringServer(
                 store=serving.ModelStore.open(args.store_dir),
                 max_batch=args.max_batch,
@@ -239,9 +357,20 @@ def run(argv: Optional[List[str]] = None, stop_event=None):
                 status_port=args.status_port,
                 **admission,
             )
+        else:
+            server = serving.ScoringServer(
+                models=model_pairs,
+                fleet_root=args.fleet_root,
+                default_model=args.default_model,
+                max_batch=args.max_batch,
+                max_latency_ms=args.max_latency_ms,
+                poll_seconds=args.poll_seconds,
+                status_port=args.status_port,
+                **admission,
+            )
         logger.info(
-            "serving snapshot %s (socket=%s listen=%s)",
-            server.snapshot_name, args.socket, args.listen,
+            "serving snapshots %s (socket=%s listen=%s)",
+            server.snapshot_names, args.socket, args.listen,
         )
         if server.status_port is not None:
             logger.info(

@@ -21,7 +21,11 @@ it is happening* instead of reading metric files after the fact:
   ``memory`` section (live host RSS + recorded HBM watermarks and
   hbm.budget headroom when streaming), and — when serving metrics exist —
   offered vs served vs shed request QPS (scrape-delta), latency quantiles,
-  and the live admission-queue depth / drain estimate.
+  and the live admission-queue depth / drain estimate. Multi-model
+  residency adds a per-model ``serving.models`` breakdown (one entry per
+  bulkhead: offered/shed/queue-depth/latency quantiles from the ``model=``
+  label), and a replica front adds ``serving_front`` (per-replica routing
+  counts, failover resubmits, liveness).
 
 All handlers read snapshots under the registry/board locks, never the live
 structures, so a scrape can never block or torn-read the training thread.
@@ -177,8 +181,71 @@ def compose_statusz(
                     m["buckets"], m["count"], q
                 )
             break
+
+    # per-model bulkhead view (multi-model residency, serving.fleet): the
+    # model= label splits every serving family, so one glance shows WHICH
+    # resident model is shedding / slow while its neighbours stay healthy
+    models: Dict[str, dict] = {}
+    for m in snap:
+        labels = m.get("labels", {})
+        model = labels.get("model")
+        if model is None:
+            continue
+        name = m["name"]
+        entry = models.setdefault(str(model), {})
+        if name == "photon_serving_offered_total":
+            entry["offered_total"] = int(
+                entry.get("offered_total", 0) + m["value"]
+            )
+        elif name == "photon_serving_requests_total":
+            entry["requests_total"] = int(
+                entry.get("requests_total", 0) + m["value"]
+            )
+        elif name == "photon_serving_shed_total":
+            by = entry.setdefault("shed_by_reason", {})
+            reason = str(labels.get("reason", ""))
+            by[reason] = int(by.get(reason, 0) + m["value"])
+        elif name == "photon_serving_queue_depth" and m["kind"] == "gauge":
+            entry["queue_depth"] = int(m["value"])
+        elif (
+            name == "photon_serving_request_latency_seconds"
+            and m["kind"] == "histogram"
+        ):
+            for q in _QUANTILES:
+                entry[f"latency_p{int(q * 100)}_seconds"] = histogram_quantile(
+                    m["buckets"], m["count"], q
+                )
+    for entry in models.values():
+        if "shed_by_reason" in entry:
+            entry["shed_total"] = sum(entry["shed_by_reason"].values())
+    if models:
+        serving["models"] = models
     if serving:
         doc["serving"] = serving
+
+    # the replica front's routing view (serving.front), when this process
+    # IS the front: where requests went, what failed over, who is up
+    front: dict = {}
+    routed = _sum_counter(snap, "photon_serving_route_total", "replica")
+    if routed:
+        front["routed_by_replica"] = {k: int(v) for k, v in routed.items()}
+        front["failover_resubmits_total"] = int(
+            _sum_counter(snap, "photon_serving_failover_resubmits_total")
+        )
+        front_sheds = _sum_counter(
+            snap, "photon_serving_front_sheds_total", "reason"
+        )
+        if front_sheds:
+            front["sheds_by_reason"] = {
+                k: int(v) for k, v in front_sheds.items()
+            }
+    for m in snap:
+        if m["name"] == "photon_serving_replica_up" and m["kind"] == "gauge":
+            front.setdefault("replica_up", {})[
+                str(m.get("labels", {}).get("replica", ""))
+            ] = int(m["value"])
+    if front:
+        doc["serving_front"] = front
     return doc
 
 

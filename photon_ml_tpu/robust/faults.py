@@ -42,7 +42,19 @@ instead of a broken one. The serving plane carries two such sites —
 delay storm there is the slow-engine chaos drill that drives the admission
 controller past its deadline budget) and ``serving.refresh`` (in the
 snapshot watcher: a delay stalls a flip, an ``io`` error there is swallowed
-and retried next poll while the live model keeps serving).
+and retried next poll while the live model keeps serving). With multi-model
+residency (``serving/fleet.py``) the batcher checks ``serving.score`` and
+then a per-model variant spelled ``serving.score.<model>`` — dynamic, so it
+is deliberately NOT in the static fault inventory — which keys a chaos
+storm to ONE resident model (``serving.score.jobs-us:delay200:p1``) and
+proves the bulkhead: the stormed model sheds, its neighbours' batches never
+feel it. The replica fleet (``serving/front.py``) adds two more sites:
+``serving.route`` at every routing decision (an injected error sheds the
+request with a typed ``route`` response — routing failures refuse, never
+drop) and ``serving.replica`` at every replica send (an injected ``io``
+error is a replica connection dying mid-request: the front marks the
+replica down and resubmits its outstanding requests — same ``trace_id`` —
+to the survivors, the failover drill without killing a process).
 
 The ``nan`` kind never raises: it acts through :func:`corrupt`, which sites
 holding concrete arrays call as ``tree = faults.corrupt(site, tree)``. When

@@ -13,17 +13,26 @@ Pieces, composable or standalone:
 - ``refresh`` — atomic snapshot publication + zero-downtime flips.
 - ``server``  — the composed resident service (+ AF_UNIX / TCP JSON-lines
   front).
+- ``fleet``   — multi-model residency: N named snapshots in one process,
+  each behind its own bulkhead (batcher + refresh watcher), routed by the
+  request protocol's ``model=`` field.
+- ``front``   — the least-loaded replica front: N ``cli serve`` replicas
+  behind one address, health-checked via ``/healthz``, with idempotent
+  trace_id resubmit when a replica dies mid-request.
 - ``loadgen`` — open-loop Poisson load generation measuring latency from
   intended send time (the coordinated-omission-proof harness behind
-  ``bench.py --config serving-openloop``).
+  ``bench.py --config serving-openloop`` / ``serving-fleet``).
 """
 
 from .batcher import SERVING_LATENCY_BUCKETS, MicroBatcher, ShedError
 from .engine import LADDER_ROWS, LADDER_WIDTH, ScoreEngine, ScoreRequest
+from .fleet import ModelSet, UnknownModelError, discover_fleet
+from .front import LeastLoadedFront, serve_front_socket
 from .loadgen import (
     OpenLoopResult,
     find_knee,
     poisson_intended_times,
+    run_mixed_open_loop,
     run_open_loop,
     simulate_fifo_closed_loop,
     simulate_fifo_open_loop,
@@ -57,9 +66,15 @@ __all__ = [
     "LADDER_WIDTH",
     "ScoreEngine",
     "ScoreRequest",
+    "ModelSet",
+    "UnknownModelError",
+    "discover_fleet",
+    "LeastLoadedFront",
+    "serve_front_socket",
     "OpenLoopResult",
     "find_knee",
     "poisson_intended_times",
+    "run_mixed_open_loop",
     "run_open_loop",
     "simulate_fifo_closed_loop",
     "simulate_fifo_open_loop",

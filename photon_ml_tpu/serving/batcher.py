@@ -24,9 +24,19 @@ tail latency for *everyone*; this batcher refuses instead of queueing:
 
 Every shed is a typed :class:`ShedError` (callers and the socket front can
 tell refusal from failure) and a counted refusal in
-``photon_serving_shed_total{reason=}``; offered load lands in
-``photon_serving_offered_total`` whether admitted or not, so
+``photon_serving_shed_total{model=,reason=}``; offered load lands in
+``photon_serving_offered_total{model=}`` whether admitted or not, so
 offered-vs-served-vs-shed rates are all derivable from one scrape.
+
+A batcher is also the per-model **bulkhead** of the multi-model fleet
+(``serving.fleet``): each resident model owns one batcher — its own worker
+thread, pending bound, deadline budget, and service-rate EWMA — and every
+serving metric this module records carries the batcher's ``model=`` label,
+so a delay storm on one model sheds (and counts) against that model alone.
+The chaos site follows the same keying: ``serving.score`` fires for every
+batch on every model, and the dynamic per-model spelling
+``serving.score.<model>`` lets a ``PHOTON_FAULTS`` storm target exactly one
+model's batches (the isolation drill in ``tests/test_serving_fleet.py``).
 
 Every completed request lands in the obs layer:
 ``photon_serving_request_latency_seconds`` (histogram, enqueue->result),
@@ -122,12 +132,17 @@ class MicroBatcher:
         max_pending: int = 1024,
         ewma_alpha: float = 0.2,
         slow_request_ms: Optional[float] = None,
+        model: str = "default",
     ):
         if max_batch < 1:
             raise ValueError("max_batch must be >= 1")
         if max_pending < 1:
             raise ValueError("max_pending must be >= 1")
         self._engine_fn = engine_fn
+        # bulkhead identity: the model= label on every metric below, and the
+        # per-model chaos-site suffix (serving.score.<model>)
+        self.model = str(model)
+        self._model_site = f"serving.score.{self.model}"
         self.max_batch = int(max_batch)
         self.max_latency_s = float(max_latency_ms) / 1e3
         self.max_pending = int(max_pending)
@@ -144,7 +159,9 @@ class MicroBatcher:
         self._pending = 0
         self._ewma_per_req: Optional[float] = None
         self._worker = threading.Thread(
-            target=self._run, name="photon-serving-batcher", daemon=True
+            target=self._run,
+            name=f"photon-serving-batcher-{self.model}",
+            daemon=True,
         )
         self._worker.start()
 
@@ -166,11 +183,11 @@ class MicroBatcher:
         stats = self.queue_stats()
         reg.gauge(
             "photon_serving_queue_depth", "admission queue: pending requests"
-        ).set(stats["pending"])
+        ).labels(model=self.model).set(stats["pending"])
         reg.gauge(
             "photon_serving_drain_estimate_seconds",
             "admission queue: estimated drain time from the service-rate EWMA",
-        ).set(stats["drain_estimate_seconds"])
+        ).labels(model=self.model).set(stats["drain_estimate_seconds"])
 
     def _dec_pending(self, n: int) -> None:
         with self._lock:
@@ -220,7 +237,9 @@ class MicroBatcher:
             if reason is None:
                 self._pending += 1
         reg = obs.current_run().registry
-        reg.counter("photon_serving_offered_total", _OFFERED_HELP).inc()
+        reg.counter("photon_serving_offered_total", _OFFERED_HELP).labels(
+            model=self.model
+        ).inc()
         # photon: ignore[R7] — closes the admission-stage interval opened by
         # the enqueue stamp; lands on the span timeline via record_span (the
         # decision spans the lock, so no context manager can bracket it)
@@ -231,7 +250,7 @@ class MicroBatcher:
         )
         if reason is not None:
             reg.counter("photon_serving_shed_total", _SHED_HELP).labels(
-                reason=reason
+                model=self.model, reason=reason
             ).inc()
             self._publish_queue_gauges(reg)
             raise ShedError(reason, msg)
@@ -284,7 +303,7 @@ class MicroBatcher:
                 (expired if deadline is not None and now > deadline else live).append(item)
             if expired:
                 reg.counter("photon_serving_shed_total", _SHED_HELP).labels(
-                    reason="expired"
+                    model=self.model, reason="expired"
                 ).inc(len(expired))
                 for _, t0, _, fut, trace in expired:
                     _stage_span(
@@ -306,8 +325,12 @@ class MicroBatcher:
                 # the slow-engine chaos site: PHOTON_FAULTS
                 # serving.score:delay50:... stalls here (exactly what a
                 # degraded accelerator does), serving.score:io:... raises
-                # into the counted error path below
+                # into the counted error path below. The second, per-model
+                # spelling keys a storm to ONE bulkhead: a
+                # serving.score.<model>:delay spec stalls only that model's
+                # batches — every other model's worker sails past it
                 faults.check("serving.score")
+                faults.check(self._model_site)
                 # photon: ignore[R7] — service-rate sample for the admission
                 # EWMA; paired read below, crosses the engine call
                 t_score = time.perf_counter()
@@ -318,7 +341,7 @@ class MicroBatcher:
                 errors = reg.counter(
                     "photon_serving_request_errors_total",
                     "requests failed inside the score engine",
-                )
+                ).labels(model=self.model)
                 errors.inc(len(live))
                 for _, t0, _, fut, trace in live:
                     _stage_span(
@@ -343,7 +366,7 @@ class MicroBatcher:
                 "photon_serving_request_latency_seconds",
                 "request latency, enqueue to scored",
                 buckets=SERVING_LATENCY_BUCKETS,
-            )
+            ).labels(model=self.model)
             n_slow = 0
             for i, (_, t0, _, fut, trace) in enumerate(live):
                 fut.set_result(float(scores[i]))
@@ -375,14 +398,14 @@ class MicroBatcher:
                 reg.counter(
                     "photon_serving_slow_requests_total",
                     "completed requests slower than the slow-request threshold",
-                ).inc(n_slow)
+                ).labels(model=self.model).inc(n_slow)
             self._dec_pending(len(live))
             reg.counter(
                 "photon_serving_requests_total", "requests scored"
-            ).inc(len(live))
+            ).labels(model=self.model).inc(len(live))
             reg.histogram(
                 "photon_serving_batch_size",
                 "rows per scored microbatch",
                 buckets=(1, 2, 4, 8, 16, 32, 64, 128, 256, 512, 1024),
-            ).observe(len(live))
+            ).labels(model=self.model).observe(len(live))
             self._publish_queue_gauges(reg)

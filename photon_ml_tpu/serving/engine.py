@@ -67,11 +67,17 @@ def _fe_score_ell(weights, feat_idx, feat_val):
 @dataclasses.dataclass(frozen=True)
 class ScoreRequest:
     """One scoring request: per-shard sparse features (already through the
-    feature index map) plus the entity id per random-effect type."""
+    feature index map) plus the entity id per random-effect type.
+
+    ``model`` routes the request in a multi-model fleet (``serving.fleet``):
+    the name of the resident model to score against, or None for the
+    server's default model. The engine itself ignores it — routing happens
+    one layer up, in the per-model bulkhead lookup."""
 
     features: Mapping[str, Tuple[Sequence[int], Sequence[float]]]
     ids: Mapping[str, object] = dataclasses.field(default_factory=dict)
     offset: float = 0.0
+    model: Optional[str] = None
 
 
 @dataclasses.dataclass(frozen=True)

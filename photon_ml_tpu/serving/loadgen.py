@@ -213,6 +213,62 @@ def run_open_loop(
     )
 
 
+# -- mixed multi-stream load (the bulkhead isolation drill) ------------------
+
+
+def run_mixed_open_loop(
+    submit: Callable[..., object],
+    streams: Dict[str, dict],
+    duration_s: float,
+    seed: int = 0,
+    drain_timeout_s: float = 30.0,
+) -> Dict[str, OpenLoopResult]:
+    """Drive several open-loop streams *concurrently* against one ``submit``
+    — the multi-model isolation drill: a storm stream hammering one model
+    must not move a victim stream's latency, because each model sits behind
+    its own bulkhead (see ``serving.fleet``).
+
+    ``streams`` maps a stream name to ``{"requests": [...], "offered_qps":
+    q}`` (optional ``"deadline_s"``); each stream's requests should already
+    carry the routing they need (e.g. ``ScoreRequest.model``). Each stream
+    gets its own dispatcher thread and a seed derived from its (sorted)
+    position, so the per-stream accounting invariant — ``sent == completed
+    + shed + errors`` — holds independently per stream."""
+    results: Dict[str, OpenLoopResult] = {}
+    failures: Dict[str, BaseException] = {}
+
+    def _run(name: str, spec: dict, stream_seed: int) -> None:
+        try:
+            results[name] = run_open_loop(
+                submit,
+                spec["requests"],
+                spec["offered_qps"],
+                duration_s,
+                seed=stream_seed,
+                deadline_s=spec.get("deadline_s"),
+                drain_timeout_s=drain_timeout_s,
+            )
+        except BaseException as exc:  # photon: ignore[R4] — parked, re-raised by the caller after join
+            failures[name] = exc
+
+    threads = [
+        threading.Thread(
+            target=_run,
+            args=(name, streams[name], seed + i),
+            name=f"photon-loadgen-{name}",
+        )
+        for i, name in enumerate(sorted(streams))
+    ]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    if failures:
+        name, exc = sorted(failures.items())[0]
+        raise RuntimeError(f"mixed load stream {name!r} failed: {exc!r}") from exc
+    return results
+
+
 # -- sweep + knee ------------------------------------------------------------
 
 

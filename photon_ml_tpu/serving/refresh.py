@@ -119,18 +119,25 @@ class RefreshWatcher:
         on_flip: Callable[[str, ModelStore], None],
         poll_seconds: float = 0.2,
         live: Optional[str] = None,
+        model: str = "default",
     ):
         self.serving_root = serving_root
         self._on_flip = on_flip
         self.poll_seconds = float(poll_seconds)
         self._live = live
+        # fleet identity: each resident model has its OWN watcher (staggered
+        # refresh — flips never synchronize across models), so the flip
+        # count and span carry the model= label
+        self.model = str(model)
         # serializes _check between the poll thread and poke() callers: both
         # run the read-compare-flip of _live, and an unserialized pair could
         # load the same snapshot twice or publish flips out of order
         self._check_lock = threading.Lock()
         self._stop = threading.Event()
         self._thread = threading.Thread(
-            target=self._run, name="photon-serving-refresh", daemon=True
+            target=self._run,
+            name=f"photon-serving-refresh-{self.model}",
+            daemon=True,
         )
         self._thread.start()
 
@@ -169,13 +176,13 @@ class RefreshWatcher:
             # the flip lands on the span timeline (and therefore in the
             # flight recorder's ring): a latency anomaly that coincides
             # with a snapshot flip is diagnosable from the postmortem alone
-            with obs.span("serving.refresh.flip", snapshot=name):
+            with obs.span("serving.refresh.flip", snapshot=name, model=self.model):
                 self._on_flip(name, store)
             self._live = name
             obs.current_run().registry.counter(
                 "photon_serving_refresh_total",
                 "model snapshots flipped in without downtime",
-            ).inc()
+            ).labels(model=self.model).inc()
 
     def _run(self) -> None:
         while not self._stop.is_set():

@@ -1,10 +1,15 @@
-"""The resident scoring service: store + engine + microbatcher + refresh,
-composed behind one `submit`/`score` surface.
+"""The resident scoring service: stores + engines + per-model microbatchers
++ refresh, composed behind one `submit`/`score` surface.
 
-The server keeps exactly one live ``ScoreEngine``; the batcher captures that
-reference once per microbatch, and a ``RefreshWatcher`` flip replaces it with
-a single attribute assignment — the GIL makes the swap atomic, the per-batch
-capture makes it *clean*: every batch scores entirely on one snapshot.
+The server holds a :class:`~photon_ml_tpu.serving.fleet.ModelSet` — one or
+many named resident models, each behind its own bulkhead (see
+``serving.fleet``). Requests route by name (``model=`` on the protocol, or
+the server's default model); each model keeps exactly one live
+``ScoreEngine``: its batcher captures that reference once per microbatch,
+and its own ``RefreshWatcher`` flip replaces it with a single attribute
+assignment — the GIL makes the swap atomic, the per-batch capture makes it
+*clean*: every batch scores entirely on one snapshot, and flips stagger
+per model.
 
 Overload protection is the batcher's deadline-budget admission control
 (``serving.batcher``): requests carry a latency budget
@@ -19,15 +24,24 @@ surface over an AF_UNIX socket (``path=``) or a TCP listener
 connection-handler::
 
     -> {"features": {"shard": [[idx...], [val...]]}, "ids": {...},
-        "offset": 0.0, "deadline_ms": 50}
-    <- {"score": 1.25, "trace_id": "..."}
+        "offset": 0.0, "deadline_ms": 50, "model": "jobs-us"}
+    <- {"score": 1.25, "model": "jobs-us", "trace_id": "..."}
      | {"error": "...", "error_type": "shed", "reason": "deadline",
-        "trace_id": "..."}
+        "model": "jobs-us", "trace_id": "..."}
      | {"error": "...", "error_type": "bad_request", "kind": "not_json",
+        "model": "default", "trace_id": "..."}
+     | {"error": "...", "error_type": "error", "model": "jobs-us",
         "trace_id": "..."}
-     | {"error": "...", "error_type": "error", "trace_id": "..."}
 
 one connection per client, one request per line, responses in order.
+``model`` is optional on requests (the server's default model otherwise)
+and echoed — resolved — on every response shape, so a fleet client can
+always attribute a response: the model the request scored (or shed)
+against, the requested name verbatim on an ``unknown_model`` refusal, and
+the default model's name when the request was too malformed to name one.
+A request naming a model the fleet does not hold (or one still warming) is
+answered with a typed ``bad_request`` kind=``unknown_model`` — counted,
+never silently scored against the default.
 Every response carries a ``trace_id`` — success, shed and bad_request
 alike — assigned per connection at accept time (or echoed back when the
 client sent its own ``"trace_id"`` field); the same id threads through the
@@ -55,9 +69,9 @@ from typing import Optional, Tuple, Union
 import jax.numpy as jnp
 
 from .. import obs
-from .batcher import MicroBatcher, RequestTrace, ShedError
+from .batcher import RequestTrace, ShedError
 from .engine import ScoreEngine, ScoreRequest
-from .refresh import RefreshWatcher, open_current
+from .fleet import ModelSet, UnknownModelError, discover_fleet
 from .store import ModelStore
 
 # One JSON-lines request must fit one line; past this the framing cannot be
@@ -66,17 +80,27 @@ MAX_REQUEST_LINE_BYTES = 1 << 20
 
 
 class ScoringServer:
-    """Resident scorer over a published serving root (or a fixed store/engine).
+    """Resident scorer over published serving roots (or fixed stores/engines).
 
     With ``serving_root`` the server opens the CURRENT snapshot and watches
     for newly published ones, flipping without dropping requests; with a
-    bare ``store``/``engine`` it serves that model until closed."""
+    bare ``store``/``engine`` it serves that model until closed. Those
+    single-model spellings serve one model named ``default``. The fleet
+    spellings hold N models, each behind its own bulkhead and refresh
+    watcher (``serving.fleet``): ``models`` maps name -> source (serving
+    root path, store dir path, ``ModelStore``, or ``ScoreEngine``);
+    ``fleet_root`` discovers one model per subdirectory. Requests route by
+    ``model`` (``--models`` name), defaulting to ``default_model`` (the
+    first model otherwise)."""
 
     def __init__(
         self,
         store: Optional[ModelStore] = None,
         engine: Optional[ScoreEngine] = None,
         serving_root: Optional[str] = None,
+        models=None,
+        fleet_root: Optional[str] = None,
+        default_model: Optional[str] = None,
         max_batch: int = 256,
         max_latency_ms: float = 2.0,
         max_pending: int = 1024,
@@ -86,34 +110,36 @@ class ScoringServer:
         dtype=jnp.float32,
         status_port: Optional[int] = None,
         slow_request_ms: Optional[float] = None,
+        per_model=None,
+        warm_async: bool = False,
     ):
-        if sum(x is not None for x in (store, engine, serving_root)) != 1:
-            raise ValueError("pass exactly one of store / engine / serving_root")
+        sources = (store, engine, serving_root, models, fleet_root)
+        if sum(x is not None for x in sources) != 1:
+            raise ValueError(
+                "pass exactly one of store / engine / serving_root / "
+                "models / fleet_root"
+            )
         self.dtype = dtype
-        self.snapshot_name: Optional[str] = None
         self.default_deadline_s: Optional[float] = (
             None if default_deadline_ms is None else float(default_deadline_ms) / 1e3
         )
-        self._lock = threading.Lock()
-        self._watcher: Optional[RefreshWatcher] = None
         self._status_server = None
-        if serving_root is not None:
-            name, store = open_current(serving_root)
-            self._install(name, store)
-            self._watcher = RefreshWatcher(
-                serving_root, self._install, poll_seconds=poll_seconds, live=name
-            )
-        elif store is not None:
-            self._install(None, store)
-        else:
-            self._engine = engine
-        self._engine.warm()
-        self._batcher = MicroBatcher(
-            self._current_engine,
+        if fleet_root is not None:
+            models = discover_fleet(fleet_root)
+        if models is None:
+            single = store if store is not None else engine
+            models = {"default": serving_root if single is None else single}
+        self._models = ModelSet(
+            models,
+            default_model=default_model,
             max_batch=max_batch,
             max_latency_ms=max_latency_ms,
             max_pending=max_pending,
             slow_request_ms=slow_request_ms,
+            per_model=per_model,
+            poll_seconds=poll_seconds,
+            dtype=dtype,
+            warm_async=warm_async,
         )
         if overload_shed_threshold is not None:
             # /healthz compares the scrape-delta shed rate against this
@@ -140,40 +166,36 @@ class ScoringServer:
         """Bound introspection port (useful with ``status_port=0``)."""
         return None if self._status_server is None else self._status_server.port
 
-    # -- refresh flip ---------------------------------------------------------
+    # -- fleet surface --------------------------------------------------------
 
-    def _install(self, name: Optional[str], store: ModelStore) -> None:
-        """Build the engine for a freshly opened store, then flip the live
-        reference in one assignment (warm first: the flip must not stall
-        in-flight traffic on a compile)."""
-        live = getattr(self, "_batcher", None) is not None
-        if live:
-            # /healthz answers 503 for exactly the mid-publish window, so a
-            # load balancer drains this replica while the flip is in flight
-            # (scoring itself keeps working — the old engine serves until
-            # the one-assignment swap below)
-            obs.current_run().status.update(refresh_in_progress=True)
-        try:
-            engine = ScoreEngine.from_store(store, dtype=self.dtype)
-            if live:
-                engine.warm()
-            with self._lock:
-                self._engine = engine
-                self.snapshot_name = name
-        finally:
-            if live:
-                obs.current_run().status.update(refresh_in_progress=False)
-        if getattr(self, "_status_server", None) is not None:
-            obs.current_run().status.update(serving_snapshot=name)
+    @property
+    def snapshot_name(self) -> Optional[str]:
+        """The default model's live snapshot (single-model compatibility)."""
+        return self._models.snapshot_names[self._models.default_model]
 
-    def _current_engine(self) -> ScoreEngine:
-        with self._lock:
-            return self._engine
+    @property
+    def snapshot_names(self) -> dict:
+        """Live snapshot per resident model."""
+        return self._models.snapshot_names
 
-    def poke_refresh(self) -> None:
-        """Force an immediate CURRENT check (tests; avoids poll sleeps)."""
-        if self._watcher is not None:
-            self._watcher.poke()
+    @property
+    def model_names(self) -> list:
+        return self._models.names
+
+    @property
+    def default_model_name(self) -> str:
+        return self._models.default_model
+
+    def resolve_model(self, model: Optional[str]) -> str:
+        """Resolved model name for a requested one (None -> default);
+        raises :class:`~photon_ml_tpu.serving.fleet.UnknownModelError` for
+        names this fleet does not hold or has not finished warming."""
+        return self._models.resolve(model)
+
+    def poke_refresh(self, model: Optional[str] = None) -> None:
+        """Force an immediate CURRENT check on one model's watcher, or all
+        of them (tests; avoids poll sleeps)."""
+        self._models.poke_refresh(model)
 
     # -- scoring surface ------------------------------------------------------
 
@@ -182,16 +204,20 @@ class ScoringServer:
         request: ScoreRequest,
         deadline_s: Optional[float] = None,
         trace: Optional[RequestTrace] = None,
+        model: Optional[str] = None,
     ):
         """Enqueue one request; returns a Future resolving to its score.
         ``deadline_s`` overrides the server's ``default_deadline_ms`` budget
         for this request (None = use the server default; the admission
-        controller may raise :class:`ShedError` immediately). ``trace``
-        threads a request-scoped trace context (trace_id + root span)
-        through the batcher's per-stage spans."""
+        controller may raise :class:`ShedError` immediately). ``model``
+        (explicit arg, else ``request.model``) routes to that model's
+        bulkhead. ``trace`` threads a request-scoped trace context
+        (trace_id + root span) through the batcher's per-stage spans."""
         if deadline_s is None:
             deadline_s = self.default_deadline_s
-        return self._batcher.submit(request, deadline_s=deadline_s, trace=trace)
+        return self._models.submit(
+            request, deadline_s=deadline_s, trace=trace, model=model
+        )
 
     def score(
         self,
@@ -199,22 +225,22 @@ class ScoringServer:
         timeout: float = 30.0,
         deadline_s: Optional[float] = None,
         trace: Optional[RequestTrace] = None,
+        model: Optional[str] = None,
     ) -> float:
         """Blocking single-request score (sheds surface as ShedError)."""
-        return self.submit(request, deadline_s=deadline_s, trace=trace).result(
-            timeout=timeout
-        )
+        return self.submit(
+            request, deadline_s=deadline_s, trace=trace, model=model
+        ).result(timeout=timeout)
 
-    def queue_stats(self) -> dict:
-        """Live admission-queue stats (pending depth + drain estimate)."""
-        return self._batcher.queue_stats()
+    def queue_stats(self, model: Optional[str] = None) -> dict:
+        """Live admission-queue stats (pending depth + drain estimate):
+        one model's by name, or the fleet aggregate on a multi-model set."""
+        return self._models.queue_stats(model)
 
     def close(self) -> None:
-        if self._watcher is not None:
-            self._watcher.stop()
         if self._status_server is not None:
             self._status_server.stop()
-        self._batcher.close()
+        self._models.close()
 
 
 # -- the socket front --------------------------------------------------------
@@ -283,6 +309,11 @@ def _parse_score_request(msg) -> Tuple[ScoreRequest, Optional[float]]:
     offset = msg.get("offset", 0.0)
     if not isinstance(offset, numbers.Real) or isinstance(offset, bool):
         raise BadRequestError("bad_fields", "'offset' must be a number")
+    model = msg.get("model")
+    if model is not None and not isinstance(model, str):
+        raise BadRequestError(
+            "bad_fields", "'model' must be a string (a resident model name)"
+        )
     deadline_ms = msg.get("deadline_ms")
     deadline_s: Optional[float] = None
     if deadline_ms is not None:
@@ -291,7 +322,10 @@ def _parse_score_request(msg) -> Tuple[ScoreRequest, Optional[float]]:
         if float(deadline_ms) <= 0:
             raise BadRequestError("bad_fields", "'deadline_ms' must be > 0")
         deadline_s = float(deadline_ms) / 1e3
-    return ScoreRequest(features=parsed, ids=ids, offset=float(offset)), deadline_s
+    return (
+        ScoreRequest(features=parsed, ids=ids, offset=float(offset), model=model),
+        deadline_s,
+    )
 
 
 # connection sequence for trace_id assignment: ids are unique per process
@@ -300,12 +334,21 @@ def _parse_score_request(msg) -> Tuple[ScoreRequest, Optional[float]]:
 _conn_ids = itertools.count(1)
 
 
+def _requested_model(msg, server: ScoringServer) -> str:
+    """Best-effort model echo for refused requests: the name the request
+    asked for when it managed to say one, else the default model (the one
+    it would have scored against)."""
+    if isinstance(msg, dict) and isinstance(msg.get("model"), str):
+        return msg["model"]
+    return server.default_model_name
+
+
 def _handle_conn(server: ScoringServer, conn: socket.socket, conns, conns_lock) -> None:
     """One JSON-lines connection: the shared handler behind both the AF_UNIX
     and the TCP listener. Registered in ``conns`` so the listener can shut
     the connection down deterministically at stop time. Every request gets
     a ``trace_id`` (``<pid>-<conn>.<seq>``, or the client's own) echoed on
-    every response shape."""
+    every response shape, and every response echoes the resolved ``model``."""
     conn_id = f"{os.getpid():x}-{next(_conn_ids)}"
     req_seq = itertools.count(1)
     try:
@@ -339,6 +382,7 @@ def _handle_conn(server: ScoringServer, conn: socket.socket, conns, conns_lock) 
                             ),
                             "error_type": "bad_request",
                             "kind": "oversized",
+                            "model": server.default_model_name,
                             "trace_id": trace_id,
                         }
                     )
@@ -359,6 +403,7 @@ def _handle_conn(server: ScoringServer, conn: socket.socket, conns, conns_lock) 
                             "error": f"request is not valid JSON: {exc}",
                             "error_type": "bad_request",
                             "kind": "not_json",
+                            "model": server.default_model_name,
                             "trace_id": trace_id,
                         }
                     ):
@@ -371,6 +416,10 @@ def _handle_conn(server: ScoringServer, conn: socket.socket, conns, conns_lock) 
                 with obs.span("serving.request", trace_id=trace_id) as root:
                     try:
                         req, deadline_s = _parse_score_request(msg)
+                        # resolve BEFORE queueing: an unknown (or still
+                        # warming) model is a typed refusal, never scored
+                        # against the default and never owed a queue slot
+                        resolved = server.resolve_model(req.model)
                     except BadRequestError as exc:
                         _count_bad_request(exc.kind)
                         root.attrs["outcome"] = "bad_request"
@@ -378,15 +427,28 @@ def _handle_conn(server: ScoringServer, conn: socket.socket, conns, conns_lock) 
                             "error": str(exc),
                             "error_type": "bad_request",
                             "kind": exc.kind,
+                            "model": _requested_model(msg, server),
+                            "trace_id": trace_id,
+                        }
+                    except UnknownModelError as exc:
+                        _count_bad_request(exc.kind)
+                        root.attrs["outcome"] = "bad_request"
+                        out = {
+                            "error": str(exc),
+                            "error_type": "bad_request",
+                            "kind": exc.kind,
+                            "model": _requested_model(msg, server),
                             "trace_id": trace_id,
                         }
                     else:
+                        root.attrs["model"] = resolved
                         trace = RequestTrace(trace_id=trace_id, parent=root)
                         try:
                             out = {
                                 "score": server.score(
                                     req, deadline_s=deadline_s, trace=trace
                                 ),
+                                "model": resolved,
                                 "trace_id": trace_id,
                             }
                             root.attrs["outcome"] = "ok"
@@ -399,6 +461,7 @@ def _handle_conn(server: ScoringServer, conn: socket.socket, conns, conns_lock) 
                                 "error": str(exc),
                                 "error_type": "shed",
                                 "reason": exc.reason,
+                                "model": resolved,
                                 "trace_id": trace_id,
                             }
                         except Exception as exc:
@@ -407,10 +470,13 @@ def _handle_conn(server: ScoringServer, conn: socket.socket, conns, conns_lock) 
                             out = {
                                 "error": str(exc),
                                 "error_type": "error",
+                                "model": resolved,
                                 "trace_id": trace_id,
                             }
                 if not respond(out):
                     break
+    except OSError:
+        pass  # makefile close flushes into a torn-down socket (replica kill)
     finally:
         with conns_lock:
             conns.discard(conn)
@@ -428,18 +494,22 @@ def _parse_listen(listen: Union[str, Tuple[str, int]]) -> Tuple[str, int]:
 
 
 def serve_socket(
-    server: ScoringServer,
+    server,
     path: Optional[str] = None,
     stop_event: Optional[threading.Event] = None,
     listen: Optional[Union[str, Tuple[str, int]]] = None,
     on_bound=None,
+    handler=None,
 ) -> None:
     """Serve ``server`` over exactly one of an AF_UNIX socket at ``path`` or
     a TCP listener at ``listen`` ("host:port" or (host, port); port 0 binds
     ephemeral) until ``stop_event`` is set (runs forever without one). One
-    thread per connection through the shared JSON-lines handler;
-    ``on_bound`` (if given) is called once with the bound address — the
-    socket path, or the (host, port) tuple with the resolved port.
+    thread per connection through the shared JSON-lines handler —
+    ``handler`` swaps it out (same ``(server, conn, conns, conns_lock)``
+    signature; the replica front's pass-through handler reuses this whole
+    accept/shutdown loop over its own routing surface). ``on_bound`` (if
+    given) is called once with the bound address — the socket path, or the
+    (host, port) tuple with the resolved port.
 
     Shutdown is deterministic: when ``stop_event`` fires, every open
     connection is shut down (interrupting blocked reads) and every handler
@@ -451,6 +521,7 @@ def serve_socket(
             "host:port)"
         )
     stop = stop_event or threading.Event()
+    handler = handler or _handle_conn
     conns: set = set()
     conns_lock = threading.Lock()
     threads = []
@@ -488,7 +559,7 @@ def serve_socket(
                 with conns_lock:
                     conns.add(conn)
                 t = threading.Thread(
-                    target=_handle_conn,
+                    target=handler,
                     args=(server, conn, conns, conns_lock),
                     daemon=True,
                 )

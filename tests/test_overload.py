@@ -339,9 +339,12 @@ def _roundtrip(f, doc):
     f.write((json.dumps(doc) + "\n").encode())
     f.flush()
     out = json.loads(f.readline())
-    # every response shape carries a request-scoped trace id; strip it so
-    # the exact-dict asserts below keep pinning the rest of the protocol
+    # every response shape carries a request-scoped trace id and echoes the
+    # resolved model (the fixture serves one unnamed store, so everything
+    # lands on the "default" bulkhead); strip both so the exact-dict asserts
+    # below keep pinning the rest of the protocol
     assert out.pop("trace_id"), out
+    assert out.pop("model") == "default", out
     return out
 
 

@@ -1,0 +1,562 @@
+"""Multi-model serving fleet (serving/fleet.py + serving/front.py): per-model
+bulkheads, staggered refresh, and the replica front's failover drill.
+
+The two chaos claims this file pins:
+
+- **Bulkhead isolation** — a ``serving.score.<model>`` delay storm keyed to
+  one resident model sheds (and counts) against that model alone; a victim
+  model sharing the process completes every request with untouched latency.
+- **Zero requests lost without a response** — kill a replica under open-loop
+  load through the least-loaded front and every dispatched request still
+  resolves: scored on a survivor (same trace_id, idempotent resubmit) or
+  refused with a typed shed. ``sent == completed + shed + errors`` with
+  ``errors == 0``.
+"""
+
+from __future__ import annotations
+
+import json
+import socket
+import threading
+import time
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from photon_ml_tpu import obs, serving
+from photon_ml_tpu.models.game import (
+    FixedEffectModel,
+    GameModel,
+    RandomEffectModel,
+)
+from photon_ml_tpu.models.glm import Coefficients, LogisticRegressionModel
+from photon_ml_tpu.obs.http import compose_statusz
+from photon_ml_tpu.plan import PlanError
+from photon_ml_tpu.robust import faults
+from photon_ml_tpu.serving.fleet import ModelSet
+from photon_ml_tpu.serving.front import LeastLoadedFront
+
+D_FIXED = 6
+D_RE = 4
+
+
+@pytest.fixture(autouse=True)
+def _clear_faults():
+    yield
+    faults.clear()
+
+
+@pytest.fixture
+def run_telemetry():
+    run = obs.RunTelemetry()
+    with obs.use_run(run):
+        yield run
+
+
+def counter_total(run, name, **labels):
+    total = 0.0
+    for m in run.registry.snapshot():
+        if m["name"] == name and m["kind"] == "counter":
+            got = m.get("labels", {})
+            if all(got.get(k) == v for k, v in labels.items()):
+                total += m["value"]
+    return total
+
+
+class FakeEngine:
+    """Jax-free stand-in for ScoreEngine: score = value + offset, optional
+    per-batch service delay (duck-typed into ModelSet like the real one)."""
+
+    def __init__(self, value=0.0, delay_s=0.0):
+        self.value = float(value)
+        self.delay_s = delay_s
+        self.batches = 0
+
+    def warm(self):
+        pass
+
+    def score_requests(self, requests):
+        if self.delay_s:
+            time.sleep(self.delay_s)
+        self.batches += 1
+        return [self.value + r.offset for r in requests]
+
+
+def fake_request(model=None, offset=0.0):
+    return serving.ScoreRequest(
+        features={"g": ((0,), (1.0,))}, offset=offset, model=model
+    )
+
+
+def make_model(fe_shift=0.0, seed=0):
+    """Small GLMix model with deterministic coefficients (store-backed
+    tests; same shape for every ``fe_shift`` so ladders share compiles)."""
+    rng = np.random.default_rng(seed)
+    fe = FixedEffectModel(
+        model=LogisticRegressionModel(
+            Coefficients(jnp.asarray(rng.standard_normal(D_FIXED) + fe_shift))
+        ),
+        feature_shard="globalShard",
+    )
+    re = RandomEffectModel(
+        random_effect_type="userId",
+        feature_shard="userShard",
+        task="logistic_regression",
+        entity_ids=np.asarray(["uA", "uB", "uC"], dtype=object),
+        coef_indices=jnp.asarray(
+            [[0, 2, -1], [1, 3, -1], [0, 1, 2]], jnp.int32
+        ),
+        coef_values=jnp.asarray(rng.standard_normal((3, 3))),
+    )
+    return GameModel(
+        models={"global": fe, "per-user": re}, task="logistic_regression"
+    )
+
+
+def store_request(rng, uid="uA", model=None):
+    gidx = np.sort(rng.choice(D_FIXED, size=4, replace=False))
+    return serving.ScoreRequest(
+        features={
+            "globalShard": (
+                tuple(int(i) for i in gidx),
+                tuple(rng.standard_normal(4).tolist()),
+            ),
+        },
+        ids={"userId": uid},
+        offset=float(rng.standard_normal()),
+        model=model,
+    )
+
+
+# -- composition refusals ----------------------------------------------------
+
+
+def test_duplicate_model_name_refused():
+    with pytest.raises(PlanError, match="duplicate model name"):
+        ModelSet([("a", FakeEngine()), ("a", FakeEngine())])
+
+
+def test_front_refuses_af_unix_replicas():
+    with pytest.raises(PlanError, match="not composable with AF_UNIX"):
+        LeastLoadedFront(["/tmp/photon-serve.sock"])
+
+
+def test_unknown_default_model_refused():
+    with pytest.raises(ValueError, match="not in the fleet"):
+        ModelSet({"a": FakeEngine()}, default_model="b")
+
+
+# -- routing + bulkheads -----------------------------------------------------
+
+
+def test_model_routing_and_unknown_model(run_telemetry):
+    ms = ModelSet(
+        {"alpha": FakeEngine(value=1.0), "beta": FakeEngine(value=100.0)},
+        default_model="alpha",
+        max_latency_ms=0.5,
+    )
+    try:
+        assert ms.submit(fake_request()).result(5.0) == 1.0
+        assert ms.submit(fake_request(model="beta")).result(5.0) == 100.0
+        # the model= argument wins over the request's own field
+        assert ms.submit(fake_request(model="beta"), model="alpha").result(
+            5.0
+        ) == 1.0
+        with pytest.raises(serving.UnknownModelError) as exc:
+            ms.resolve("gamma")
+        assert exc.value.kind == "unknown_model"
+        assert "this fleet holds ['alpha', 'beta']" in str(exc.value)
+    finally:
+        ms.close()
+
+
+def test_ten_model_storm_isolates_to_one_bulkhead(run_telemetry):
+    """The tentpole isolation drill: a delay storm keyed to ONE of ten
+    resident models (the dynamic ``serving.score.<model>`` site) sheds that
+    model past its deadline budget while a victim model in the same process
+    completes every request at untouched latency."""
+    engines = {f"m{i}": FakeEngine(value=i) for i in range(10)}
+    ms = ModelSet(engines, max_latency_ms=0.5, max_pending=64)
+    try:
+        # every batch of m3 stalls 50ms; no other model's site fires
+        faults.configure("serving.score.m3:delay50:p1", seed=1)
+        results = serving.run_mixed_open_loop(
+            ms.submit,
+            {
+                "storm": {
+                    "requests": [fake_request(model="m3")],
+                    "offered_qps": 120.0,
+                    "deadline_s": 0.02,
+                },
+                "victim": {
+                    "requests": [fake_request(model="m5")],
+                    "offered_qps": 60.0,
+                },
+            },
+            duration_s=1.0,
+        )
+        storm, victim = results["storm"], results["victim"]
+        # accounting invariant per stream: nothing unaccounted for
+        for r in (storm, victim):
+            assert r.sent == (
+                r.completed
+                + sum(r.shed_admission.values())
+                + r.shed_expired
+                + r.errors
+            )
+        # the stormed model sheds (50ms batches vs a 20ms budget)...
+        assert storm.shed_total > 0
+        assert storm.errors == 0
+        # ...while the victim never sheds, never errors, and never waits
+        # behind the stormed model's batches
+        assert victim.errors == 0
+        assert victim.shed_total == 0
+        assert victim.completed == victim.sent
+        assert victim.latency_p99_s < 0.04
+        # the refusals counted against the stormed bulkhead alone
+        assert counter_total(
+            run_telemetry, "photon_serving_shed_total", model="m3"
+        ) == storm.shed_total
+        assert counter_total(
+            run_telemetry, "photon_serving_shed_total", model="m5"
+        ) == 0
+        # and the statusz per-model section tells the two models apart
+        doc = compose_statusz(run_telemetry)
+        models = doc["serving"]["models"]
+        assert models["m3"]["shed_total"] > 0
+        assert "shed_by_reason" not in models["m5"]
+        assert models["m5"]["latency_p99_seconds"] < 0.04
+    finally:
+        ms.close()
+
+
+# -- staggered refresh over real stores --------------------------------------
+
+
+def test_staggered_refresh_flips_models_independently(
+    run_telemetry, tmp_path
+):
+    rng = np.random.default_rng(0)
+    root_a, root_b = str(tmp_path / "a"), str(tmp_path / "b")
+    serving.publish_snapshot(root_a, "v1", game_model=make_model(0.0))
+    serving.publish_snapshot(root_b, "v1", game_model=make_model(5.0))
+    ms = ModelSet({"a": root_a, "b": root_b}, max_latency_ms=0.5)
+    try:
+        req = store_request(rng)
+        s_a1 = ms.submit(req, model="a").result(10.0)
+        s_b1 = ms.submit(req, model="b").result(10.0)
+        assert ms.snapshot_names == {"a": "v1", "b": "v1"}
+
+        # flip a alone: b's watcher never moves
+        serving.publish_snapshot(root_a, "v2", game_model=make_model(2.0))
+        ms.poke_refresh("a")
+        assert ms.snapshot_names == {"a": "v2", "b": "v1"}
+        assert ms.submit(req, model="a").result(10.0) != s_a1
+        assert ms.submit(req, model="b").result(10.0) == s_b1
+
+        # a torn publish on b (CURRENT names a snapshot that never landed)
+        # is swallowed: b keeps serving v1, a keeps serving v2
+        (tmp_path / "b" / "CURRENT").write_text("v9-missing\n")
+        ms.poke_refresh("b")
+        assert ms.snapshot_names == {"a": "v2", "b": "v1"}
+        assert ms.submit(req, model="b").result(10.0) == s_b1
+        assert counter_total(
+            run_telemetry,
+            "photon_swallowed_errors_total",
+            site="serving.refresh",
+        ) >= 1
+
+        # the next good publish repairs b without touching a
+        serving.publish_snapshot(root_b, "v2", game_model=make_model(7.0))
+        ms.poke_refresh("b")
+        assert ms.snapshot_names == {"a": "v2", "b": "v2"}
+        assert ms.submit(req, model="b").result(10.0) != s_b1
+        # per-model flip counts: a flipped once, b once
+        assert counter_total(
+            run_telemetry, "photon_serving_refresh_total", model="a"
+        ) == 1
+        assert counter_total(
+            run_telemetry, "photon_serving_refresh_total", model="b"
+        ) == 1
+    finally:
+        ms.close()
+
+
+def test_same_shape_models_share_compiled_executables(run_telemetry, tmp_path):
+    """The ladder executables are keyed by shape, not by model: warming the
+    Nth same-shape model of a fleet compiles nothing new."""
+    from photon_ml_tpu.serving.engine import ScoreEngine, _fe_score_ell
+
+    sa = serving.build_store_from_model(make_model(0.0), str(tmp_path / "a"))
+    sb = serving.build_store_from_model(make_model(3.0), str(tmp_path / "b"))
+    e1 = ScoreEngine.from_store(serving.ModelStore.open(sa))
+    e1.warm()
+    cached = _fe_score_ell._cache_size()
+    e2 = ScoreEngine.from_store(serving.ModelStore.open(sb))
+    e2.warm()
+    assert _fe_score_ell._cache_size() == cached
+
+
+# -- the protocol's model field over a real socket ---------------------------
+
+
+def _serve_tcp(server_like, serve_fn=None):
+    """Start a TCP listener for ``server_like`` on an ephemeral port;
+    returns (addr_str, stop_event, thread)."""
+    stop = threading.Event()
+    bound = {}
+    ready = threading.Event()
+    serve = serve_fn or serving.serve_socket
+    t = threading.Thread(
+        target=serve,
+        kwargs=dict(
+            listen="127.0.0.1:0",
+            stop_event=stop,
+            on_bound=lambda a: (bound.update(addr=a), ready.set()),
+        ),
+        args=(server_like,),
+        daemon=True,
+    )
+    t.start()
+    assert ready.wait(10.0)
+    host, port = bound["addr"][:2]
+    return f"{host}:{port}", stop, t
+
+
+def _rpc(addr, doc):
+    host, port = addr.rsplit(":", 1)
+    with socket.create_connection((host, int(port))) as s, s.makefile(
+        "rwb"
+    ) as f:
+        f.write((json.dumps(doc) + "\n").encode())
+        f.flush()
+        return json.loads(f.readline())
+
+
+def test_model_echo_on_every_response_shape(run_telemetry):
+    server = serving.ScoringServer(
+        models={"alpha": FakeEngine(value=1.0), "beta": FakeEngine(value=2.0)},
+        default_model="alpha",
+        max_latency_ms=0.5,
+    )
+    addr, stop, t = _serve_tcp(server)
+    try:
+        doc = {"features": {"g": [[0], [1.0]]}, "offset": 0.5}
+        ok = _rpc(addr, doc)
+        assert (ok["score"], ok["model"]) == (1.5, "alpha")
+        ok = _rpc(addr, {**doc, "model": "beta"})
+        assert (ok["score"], ok["model"]) == (2.5, "beta")
+        # unknown model: typed bad_request, counted, echoes the asked-for name
+        bad = _rpc(addr, {**doc, "model": "gamma"})
+        assert bad["error_type"] == "bad_request"
+        assert bad["kind"] == "unknown_model"
+        assert bad["model"] == "gamma"
+        assert "this fleet holds" in bad["error"]
+        assert counter_total(
+            run_telemetry,
+            "photon_serving_bad_request_total",
+            kind="unknown_model",
+        ) == 1
+        # a non-string model field is a bad_fields refusal
+        bad = _rpc(addr, {**doc, "model": 7})
+        assert (bad["kind"], bad["model"]) == ("bad_fields", "alpha")
+        # malformed JSON still answers (echoing the default model)
+        host, port = addr.rsplit(":", 1)
+        with socket.create_connection((host, int(port))) as s, s.makefile(
+            "rwb"
+        ) as f:
+            f.write(b"not json\n")
+            f.flush()
+            out = json.loads(f.readline())
+        assert (out["kind"], out["model"]) == ("not_json", "alpha")
+    finally:
+        stop.set()
+        t.join(timeout=10.0)
+        server.close()
+
+
+# -- the replica front -------------------------------------------------------
+
+
+def _two_replica_fleet(value=1.0):
+    """Two single-model TCP replicas over fake engines + their stops."""
+    servers, stops, threads, addrs = [], [], [], []
+    for _ in range(2):
+        srv = serving.ScoringServer(
+            engine=FakeEngine(value=value), max_latency_ms=0.5
+        )
+        addr, stop, t = _serve_tcp(srv)
+        servers.append(srv)
+        stops.append(stop)
+        threads.append(t)
+        addrs.append(addr)
+    return servers, stops, threads, addrs
+
+
+def test_front_routes_least_loaded_and_fails_over(run_telemetry):
+    """The replica-kill chaos drill: open-loop load through the front, one
+    replica killed mid-run — zero requests end without a response."""
+    servers, stops, threads, addrs = _two_replica_fleet()
+    front = LeastLoadedFront(addrs, health_poll_seconds=0.1)
+    try:
+        assert front.score(fake_request(offset=0.25)) == 1.25
+        reqs = [fake_request(offset=float(i)) for i in range(8)]
+        holder = {}
+
+        def drive():
+            holder["r"] = serving.run_open_loop(
+                front.submit, reqs, offered_qps=250.0, duration_s=1.2
+            )
+
+        dt = threading.Thread(target=drive)
+        dt.start()
+        time.sleep(0.4)
+        stops[0].set()  # kill replica 0: conns shut down mid-request
+        dt.join()
+        r = holder["r"]
+        assert r.sent == (
+            r.completed
+            + sum(r.shed_admission.values())
+            + r.shed_expired
+            + r.errors
+        )
+        assert r.errors == 0, "a request ended without a typed response"
+        assert r.completed > 0
+        # both replicas carried traffic before the kill; the survivor
+        # carried everything after it
+        routed = {
+            a: counter_total(
+                run_telemetry, "photon_serving_route_total", replica=a
+            )
+            for a in addrs
+        }
+        assert all(v > 0 for v in routed.values())
+        states = front.replica_states()
+        assert not states[addrs[0]]["up"]
+        assert states[addrs[1]]["up"]
+    finally:
+        front.close()
+        for stop in stops:
+            stop.set()
+        for t in threads:
+            t.join(timeout=10.0)
+        for srv in servers:
+            srv.close()
+
+
+def test_front_fault_sites_shed_typed(run_telemetry):
+    """The two literal fleet fault sites: an injected ``serving.route``
+    error sheds the request (typed, counted); an injected
+    ``serving.replica`` error on a single-replica fleet exhausts the
+    candidates into a typed ``no_replica`` shed — refusals, never drops."""
+    servers, stops, threads, addrs = _two_replica_fleet()
+    front = LeastLoadedFront(addrs[:1], health_poll_seconds=0.1)
+    try:
+        faults.configure("serving.route:io:1")
+        with pytest.raises(serving.ShedError) as exc:
+            front.score(fake_request())
+        assert exc.value.reason == "route"
+        faults.configure("serving.replica:io:1")
+        with pytest.raises(serving.ShedError) as exc:
+            front.score(fake_request())
+        assert exc.value.reason == "no_replica"
+        assert counter_total(
+            run_telemetry, "photon_serving_front_sheds_total", reason="route"
+        ) == 1
+        assert counter_total(
+            run_telemetry,
+            "photon_serving_front_sheds_total",
+            reason="no_replica",
+        ) == 1
+        faults.clear()
+        # the maintenance thread reconnects the failed replica; the fleet
+        # serves again without operator action
+        deadline = time.monotonic() + 10.0
+        while time.monotonic() < deadline:
+            try:
+                assert front.score(fake_request(offset=1.0)) == 2.0
+                break
+            except serving.ShedError:
+                time.sleep(0.05)
+        else:
+            pytest.fail("replica never rejoined the rotation")
+    finally:
+        front.close()
+        for stop in stops:
+            stop.set()
+        for t in threads:
+            t.join(timeout=10.0)
+        for srv in servers:
+            srv.close()
+
+
+def test_front_connection_pool_channels(run_telemetry):
+    """``connections_per_replica`` opens K independent channels per replica
+    (the JSON-lines protocol is serial per connection, so K is the front's
+    concurrency into one replica). Channels show up as ``addr#k`` entries,
+    score correctly, and spread concurrent load."""
+    servers, stops, threads, addrs = _two_replica_fleet()
+    front = LeastLoadedFront(
+        addrs, health_poll_seconds=0.1, connections_per_replica=2
+    )
+    try:
+        states = front.replica_states()
+        expected = {addrs[0], f"{addrs[0]}#1", addrs[1], f"{addrs[1]}#1"}
+        assert set(states) == expected
+        assert front.score(fake_request(offset=0.25)) == 1.25
+        reqs = [fake_request(offset=float(i)) for i in range(8)]
+        r = serving.run_open_loop(
+            front.submit, reqs, offered_qps=200.0, duration_s=0.6
+        )
+        assert r.errors == 0 and r.completed > 0
+        routed = {
+            a: counter_total(
+                run_telemetry, "photon_serving_route_total", replica=a
+            )
+            for a in expected
+        }
+        # concurrent load reaches beyond one channel per replica
+        assert sum(v > 0 for v in routed.values()) >= 3
+        assert sum(routed.values()) >= r.completed
+    finally:
+        front.close()
+        for stop in stops:
+            stop.set()
+        for t in threads:
+            t.join(timeout=10.0)
+        for srv in servers:
+            srv.close()
+
+
+def test_front_connection_pool_rejects_nonpositive():
+    with pytest.raises(ValueError):
+        LeastLoadedFront(["127.0.0.1:1"], connections_per_replica=0)
+
+
+def test_front_socket_passthrough(run_telemetry):
+    """``serve_front_socket``: clients speak the replica protocol to the
+    front; responses (score, model echo, trace_id) relay back verbatim."""
+    from photon_ml_tpu.serving.front import serve_front_socket
+
+    servers, stops, threads, addrs = _two_replica_fleet(value=3.0)
+    front = LeastLoadedFront(addrs, health_poll_seconds=0.1)
+    faddr, fstop, ft = _serve_tcp(front, serve_fn=serve_front_socket)
+    try:
+        out = _rpc(faddr, {"features": {"g": [[0], [1.0]]}, "offset": 1.0})
+        assert out["score"] == 4.0
+        assert out["model"] == "default"
+        assert out["trace_id"]
+        out = _rpc(faddr, {"features": "nonsense"})
+        assert out["error_type"] == "bad_request"
+    finally:
+        fstop.set()
+        ft.join(timeout=10.0)
+        front.close()
+        for stop in stops:
+            stop.set()
+        for t in threads:
+            t.join(timeout=10.0)
+        for srv in servers:
+            srv.close()

@@ -176,6 +176,18 @@ def _trigger_socket_and_listen(raw):
     check_socket_front("/tmp/serve.sock", "127.0.0.1:8473")
 
 
+def _trigger_fleet_duplicate_model(raw):
+    from photon_ml_tpu.plan import check_fleet_composition
+
+    check_fleet_composition(["jobs-us", "jobs-emea", "jobs-us"])
+
+
+def _trigger_fleet_front_af_unix(raw):
+    from photon_ml_tpu.plan import check_fleet_composition
+
+    check_fleet_composition((), front_replicas=["/tmp/photon-serve.sock"])
+
+
 def _trigger_serving_store_version(raw, tmp_path):
     import json as _json
 
@@ -563,6 +575,19 @@ CASES = [
         "server process)",
         ValueError,
         _trigger_socket_and_listen,
+    ),
+    (
+        "fleet-duplicate-model",
+        "duplicate model name in the serving fleet",
+        PlanError,
+        _trigger_fleet_duplicate_model,
+    ),
+    (
+        "fleet-front-af-unix",
+        "the replica front routes over TCP replicas: not composable with "
+        "AF_UNIX socket paths",
+        PlanError,
+        _trigger_fleet_front_af_unix,
     ),
     (
         "disk-slice-bad-layout",
